@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Benchmark driver: GPT-2 training throughput on the available chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: GPT-2 training tokens/sec/chip (the BASELINE.json north-star family;
+GPT-2-1.5B needs a v5p pod — on the single bench chip we run the largest
+GPT-2 that fits and normalize via MFU).
+
+``vs_baseline``: our model-flops-utilization divided by the reference's
+best published single-chip utilization — DeepSpeed's fused-kernel BERT-Large
+at 64 TFLOPS on a 125-TFLOPS-peak V100 (BASELINE.md, bert-pretraining.md:388)
+= 0.512 MFU.  >1.0 means we use our silicon better than DeepSpeed used its.
+"""
+import json
+import sys
+import time
+
+MODEL = "gpt2-125m"
+SEQ = 1024
+STEPS = 12
+WARMUP = 3
+REF_MFU = 64.0 / 125.0  # DeepSpeed BERT-Large on V100: published best single-chip
+
+# bf16 peak TFLOPS per chip by TPU generation
+PEAK_TFLOPS = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+               "v6 lite": 918e12, "v6e": 918e12, "cpu": 1e12}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = 1e12
+    for key, val in PEAK_TFLOPS.items():
+        if key in getattr(dev, "device_kind", "").lower():
+            peak = val
+            break
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    if on_tpu:
+        preset, seq, micro = MODEL, SEQ, 8
+    else:  # CI / smoke fallback
+        preset, seq, micro = "gpt2-tiny", 128, 4
+
+    cfg = gpt2_config(preset, n_positions=seq, scan_layers=True, remat=True,
+                      attn_impl="auto")
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+            "gradient_clipping": 1.0,
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 1000000,
+        })
+    engine.init_params()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(engine.train_batch_size, seq)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+
+    # NOTE: block_until_ready is unreliable on tunneled backends; a scalar
+    # device_get is a true fence (device queues are FIFO).
+    for _ in range(WARMUP):
+        loss = engine.train_batch(batch)
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss = engine.train_batch(batch)
+    jax.device_get(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = engine.train_batch_size * seq
+    tokens_per_sec = tokens_per_step * STEPS / dt
+    # 3x forward flops for fwd+bwd; +1x for remat recompute is NOT counted
+    # (standard MFU convention counts model flops, not recompute)
+    flops_per_token = 3.0 * model.flops_per_token()
+    mfu = tokens_per_sec * flops_per_token / peak
+    result = {
+        "metric": f"{preset} train tokens/sec/chip (seq {seq}, zero1, bf16)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / REF_MFU, 3),
+        "extra": {"mfu": round(mfu, 4), "chip": getattr(dev, "device_kind", str(dev)),
+                  "final_loss": float(jax.device_get(loss)),
+                  "step_ms": round(1000 * dt / STEPS, 1)},
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

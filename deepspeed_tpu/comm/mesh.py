@@ -1,0 +1,199 @@
+"""Named-axis device mesh: the single source of parallelism topology.
+
+This replaces three subsystems of the reference with one object:
+
+- ``deepspeed/utils/groups.py`` (model/expert/data process-group creation,
+  e.g. ``_create_expert_and_data_parallel`` at :108)
+- ``deepspeed/runtime/pipe/topology.py`` (``ProcessTopology`` :12,
+  ``PipelineParallelGrid`` :252 — cartesian rank grids + group handles)
+- the implicit "world" of ``deepspeed.comm`` process groups.
+
+On TPU all of that collapses into one ``jax.sharding.Mesh`` with named axes.
+A "process group" is just a mesh axis (or tuple of axes); XLA lowers
+collectives over those axes onto the ICI torus (and DCN across slices).
+
+Axis vocabulary (outermost → innermost):
+
+==========  =====================================================
+``pp``      pipeline stages (coarsest; cross-slice/DCN friendly)
+``dp``      pure data parallel (replicated params)
+``fsdp``    ZeRO/FSDP shard axis (params/grads/optimizer states)
+``ep``      expert parallel (MoE all-to-all rides here)
+``sp``      sequence/context parallel (ring attention)
+``tp``      tensor parallel (innermost → fastest ICI hops)
+==========  =====================================================
+
+Batch is sharded over ``(dp, fsdp, ep)``; experts over ``ep``; long
+sequences over ``sp``; weight matrices over ``tp`` (+ ``fsdp`` at ZeRO-3).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from contextlib import contextmanager
+from typing import Optional, Sequence
+
+import numpy as np
+
+MESH_AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+#: axes over which the batch dimension is sharded
+DATA_AXES = ("dp", "fsdp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each mesh axis; ``-1`` means "absorb remaining devices".
+
+    At most one axis may be ``-1`` (default: ``dp``). The product of all
+    axis sizes must equal the number of devices.
+    """
+
+    pp: int = 1
+    dp: int = -1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = {a: getattr(self, a) for a in MESH_AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"fixed axis product {fixed} does not divide device count {n_devices}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axis product {fixed} != device count {n_devices}; "
+                f"set one axis to -1 to infer it"
+            )
+        return MeshConfig(**sizes)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MeshConfig":
+        known = {k: int(v) for k, v in d.items() if k in MESH_AXES}
+        unknown = set(d) - set(MESH_AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {MESH_AXES}")
+        # If the user placed the -1 wildcard themselves, unmentioned axes
+        # default to 1 (NOT to dp's -1 default, which would conflict).
+        if any(v == -1 for v in known.values()):
+            base = {a: 1 for a in MESH_AXES}
+            base.update(known)
+            return MeshConfig(**base)
+        return MeshConfig(**known)
+
+    def as_dict(self) -> dict:
+        return {a: getattr(self, a) for a in MESH_AXES}
+
+
+def build_mesh(config: MeshConfig | dict | None = None,
+               devices: Optional[Sequence] = None):
+    """Create a ``jax.sharding.Mesh`` with the canonical named axes.
+
+    Device order: JAX returns devices in a topology-aware order; we reshape
+    so ``tp`` varies fastest (adjacent ICI neighbours) and ``pp`` slowest
+    (tolerates DCN), mirroring how the reference puts model-parallel ranks
+    on NVLink and pipeline stages across nodes
+    (``runtime/pipe/topology.py:246`` axis order ``['pipe','data','model']``).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig()
+    elif isinstance(config, dict):
+        config = MeshConfig.from_dict(config)
+    config = config.resolve(len(devices))
+    shape = tuple(getattr(config, a) for a in MESH_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Global mesh registry — the analog of deepspeed.utils.groups module state.
+# ---------------------------------------------------------------------------
+_CURRENT_MESH = None
+
+
+def set_mesh(mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_mesh(required: bool = True):
+    if _CURRENT_MESH is None and required:
+        raise RuntimeError(
+            "no global mesh set; call deepspeed_tpu.comm.init_distributed() / "
+            "build_mesh()+set_mesh() first"
+        )
+    return _CURRENT_MESH
+
+
+@contextmanager
+def mesh_context(mesh):
+    global _CURRENT_MESH
+    prev = _CURRENT_MESH
+    _CURRENT_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _CURRENT_MESH = prev
+
+
+# -- axis helpers (the analog of groups.get_*_parallel_world_size) ----------
+
+def axis_size(mesh, axis) -> int:
+    if isinstance(axis, (tuple, list)):
+        return math.prod(mesh.shape[a] for a in axis)
+    return mesh.shape[axis]
+
+
+def data_parallel_size(mesh) -> int:
+    """World size over which the batch is split (dp × fsdp × ep)."""
+    return axis_size(mesh, DATA_AXES)
+
+
+def model_parallel_size(mesh) -> int:
+    return axis_size(mesh, "tp")
+
+
+def pipe_parallel_size(mesh) -> int:
+    return axis_size(mesh, "pp")
+
+
+def expert_parallel_size(mesh) -> int:
+    return axis_size(mesh, "ep")
+
+
+def sequence_parallel_size(mesh) -> int:
+    return axis_size(mesh, "sp")
+
+
+def batch_spec(mesh=None, extra_dims: int = 0):
+    """PartitionSpec sharding a leading batch dim over the data axes.
+
+    ``extra_dims`` trailing dims are left unsharded.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return P(DATA_AXES, *([None] * extra_dims))
+
+
+def batch_sharding(mesh, extra_dims: int = 0):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, batch_spec(mesh, extra_dims))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

@@ -1,0 +1,108 @@
+"""Pure-math process topology: coordinates ↔ ranks on an N-D axis grid.
+
+Port-equivalent of the reference's ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology`` :12, ``PipeModelDataParallelTopology`` :246,
+``PipelineParallelGrid`` :252) — the rank-grid arithmetic is pure Python
+there and stays pure Python here.  In the TPU build the *live* grouping is
+the ``jax.sharding.Mesh`` (see ``mesh.py``); this class exists for
+(a) launcher/debug tooling that reasons about ranks without devices,
+(b) pipeline-stage bookkeeping, and (c) parity with the reference tests
+(``tests/unit/test_topology.py``).
+"""
+from __future__ import annotations
+
+import itertools
+from collections import namedtuple
+
+
+class ProcessTopology:
+    """Maps n-dim cartesian coordinates to linear ranks, axes-major order.
+
+    ``axes`` is ordered outermost-first: the LAST axis varies fastest with
+    rank (same convention as reference ``topology.py:12``).
+    """
+
+    def __init__(self, axes: list[str], dims: list[int]):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims must have equal length")
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        for ranges in itertools.product(*[range(d) for d in dims]):
+            key = dict(zip(axes, ranges))
+            coord = self.ProcessCoord(**key)
+            self.mapping[coord] = len(self.mapping)
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() requires all axes {self.axes}")
+        key = self.ProcessCoord(**coord_kwargs)
+        return self.mapping[key]
+
+    def get_axis_names(self) -> list[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank: int, omit_axes: tuple = ("data",), inner_sep: str = "_",
+                      outer_sep: str = "-") -> str:
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> list[list[int]]:
+        """Groups of ranks that differ only along ``axis`` (= a comm group)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other_coords in itertools.product(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other_coords))
+            ranks = [self.get_rank(**fixed, **{axis: i}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> list[int]:
+        """Ranks whose coordinates match all given axis=value filters."""
+
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(idx for coord, idx in self.mapping.items() if _match(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> list[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """2-D pipe × data grid (reference ``topology.py:232``)."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3-D pipe × data × model grid (reference ``topology.py:246``)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])

@@ -1,0 +1,78 @@
+"""Environment/capability report — the ``ds_report`` analog.
+
+Reference: ``bin/ds_report`` → ``deepspeed/env_report.py`` (op
+compatibility/install matrix).  On TPU there is no op-builder matrix;
+the meaningful capability probes are: backend/devices, Pallas kernel
+availability, native extension availability, and library versions.
+"""
+from __future__ import annotations
+
+import importlib
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try_version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return "not installed"
+
+
+def probe_kernels() -> dict:
+    """Capability probing, the ``is_compatible()`` analog (op_builder/builder.py:217)."""
+    results = {}
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "none"
+    results["backend"] = platform
+    try:
+        from deepspeed_tpu.ops.pallas import flash_attention  # noqa: F401
+
+        results["pallas_flash_attention"] = platform == "tpu"
+    except Exception:
+        results["pallas_flash_attention"] = False
+    try:
+        from deepspeed_tpu.ops import native  # noqa: F401
+
+        results["native_cpu_ops"] = native.available()
+    except Exception:
+        results["native_cpu_ops"] = False
+    return results
+
+
+def main() -> int:
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    print(f"python ................ {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        print(f"{mod:<22} {_try_version(mod)}")
+    try:
+        import jax
+
+        print(f"devices ............... {jax.device_count()} × "
+              f"{getattr(jax.devices()[0], 'device_kind', jax.devices()[0].platform)}")
+        print(f"process count ......... {jax.process_count()}")
+    except Exception as e:  # noqa: BLE001
+        print(f"devices ............... unavailable ({e})")
+    print("-" * 60)
+    print("capability probes")
+    for name, ok in probe_kernels().items():
+        if isinstance(ok, bool):
+            print(f"{name:<28} {GREEN_OK if ok else RED_NO}")
+        else:
+            print(f"{name:<28} {ok}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""``dstpu`` CLI — the cluster launcher.
+
+Analog of the reference ``deepspeed`` CLI (``bin/deepspeed`` →
+``launcher/runner.py:317`` with hostfile parsing :157, ``--include/
+--exclude`` filters :198, PDSH/MPI runners ``multinode_runner.py``) and the
+per-node ``launcher/launch.py:90`` that forks one process per GPU.
+
+TPU pods are radically simpler: ONE process per host, and JAX discovers pod
+topology itself.  So the launcher's jobs reduce to:
+
+- single host (default): exec the training script in-process env.
+- multi-host emulation (``--num_processes N``): fork N local processes with
+  ``DSTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID`` env (the MASTER_ADDR/RANK
+  analog) — used for CPU multi-process testing.
+- hostfile mode (``--hostfile``): ssh to each host and run the command
+  there (pdsh-style fan-out, reference ``multinode_runner.py:45``) — on
+  real TPU pods prefer the cloud tooling; this covers bare-metal parity.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+
+def parse_hostfile(path: str) -> dict[str, int]:
+    """``hostname slots=N`` lines → {host: slots} (reference runner.py:157)."""
+    hosts: dict[str, int] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            hosts[host] = slots
+    if not hosts:
+        raise ValueError(f"hostfile {path} contains no hosts")
+    return hosts
+
+
+def filter_hosts(hosts: dict[str, int], include: str = "", exclude: str = "") -> dict[str, int]:
+    """``--include/--exclude host1,host2`` filters (reference runner.py:198)."""
+    if include:
+        wanted = set(include.split(","))
+        hosts = {h: s for h, s in hosts.items() if h in wanted}
+    if exclude:
+        dropped = set(exclude.split(","))
+        hosts = {h: s for h, s in hosts.items() if h not in dropped}
+    if not hosts:
+        raise ValueError("host filters removed every host")
+    return hosts
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="DeepSpeed-TPU distributed launcher")
+    p.add_argument("--hostfile", type=str, default=None)
+    p.add_argument("--include", type=str, default="")
+    p.add_argument("--exclude", type=str, default="")
+    p.add_argument("--num_processes", type=int, default=1,
+                   help="local multi-process emulation (CPU testing)")
+    p.add_argument("--coordinator_port", type=int, default=7777)
+    p.add_argument("--master_addr", type=str, default="127.0.0.1")
+    p.add_argument("--ssh_port", type=int, default=22)
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _launch_local_procs(args) -> int:
+    """Fork N local processes with rendezvous env (launch.py:90 analog)."""
+    procs = []
+    coord = f"{args.master_addr}:{args.coordinator_port}"
+    for pid_idx in range(args.num_processes):
+        env = dict(os.environ,
+                   DSTPU_COORDINATOR=coord,
+                   DSTPU_NUM_PROCESSES=str(args.num_processes),
+                   DSTPU_PROCESS_ID=str(pid_idx))
+        cmd = [sys.executable, args.user_script] + args.user_args
+        logger.info(f"launching process {pid_idx}: {' '.join(map(shlex.quote, cmd))}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def _kill(signum, frame):  # SIGINT/SIGTERM fan-out (launch.py:176)
+        for pr in procs:
+            pr.terminate()
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for pr in procs:
+        pr.wait()
+        rc = rc or pr.returncode
+    return rc
+
+
+def _launch_hostfile(args) -> int:
+    hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
+    host_list = list(hosts)
+    coord = f"{host_list[0]}:{args.coordinator_port}"
+    procs = []
+    for idx, host in enumerate(host_list):
+        remote_cmd = (
+            f"cd {shlex.quote(os.getcwd())} && "
+            f"DSTPU_COORDINATOR={coord} DSTPU_NUM_PROCESSES={len(host_list)} "
+            f"DSTPU_PROCESS_ID={idx} "
+            f"{shlex.quote(sys.executable)} {shlex.quote(args.user_script)} "
+            + " ".join(map(shlex.quote, args.user_args)))
+        cmd = ["ssh", "-p", str(args.ssh_port), host, remote_cmd]
+        logger.info(f"ssh launch on {host} (rank {idx})")
+        procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for pr in procs:
+        pr.wait()
+        rc = rc or pr.returncode
+    return rc
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.user_args and args.user_args[0] == "--":
+        args.user_args = args.user_args[1:]
+    if args.hostfile:
+        return _launch_hostfile(args)
+    if args.num_processes > 1:
+        return _launch_local_procs(args)
+    # single process: exec in place (the common TPU case — one proc/host)
+    os.execv(sys.executable, [sys.executable, args.user_script] + args.user_args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

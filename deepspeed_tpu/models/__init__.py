@@ -1,0 +1,2 @@
+from .common import TP_RULES, cross_entropy_loss, shift_labels  # noqa: F401
+from .gpt2 import GPT2Config, GPT2LMHeadModel, gpt2_config  # noqa: F401

@@ -1,0 +1,90 @@
+"""Shared model-zoo plumbing: logical-axis vocabulary, losses, helpers.
+
+The reference adapts user models via ``module_inject`` policy classes that
+record where q/k/v/mlp weights live per architecture
+(``deepspeed/module_inject/replace_policy.py``).  The TPU-native zoo instead
+*annotates parameters at definition time* with logical axis names; a rules
+table maps logical names → mesh axes per parallelism config, which is the
+whole TP/FSDP story (no monkey-patching).
+
+Logical axis vocabulary used by every model in the zoo:
+
+==========  ==================================================
+``vocab``   embedding-table vocab dim / LM-head output dim
+``embed``   model (hidden) dim
+``qkv``     fused attention projection output dim (3·embed)
+``heads``   attention-head dim groupings (o-proj input)
+``mlp``     feed-forward hidden dim
+``experts`` MoE expert dim
+``layers``  stacked-layer dim introduced by ``nn.scan``
+==========  ==================================================
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# Mapping logical axis name -> mesh axis (or tuple), per parallelism style.
+# ``None`` = replicated along that dim.
+TP_RULES = {
+    "vocab": "tp",
+    "qkv": "tp",
+    "heads": "tp",
+    "mlp": "tp",
+    "experts": "ep",       # expert dim of MoE weights
+    "experts_gate": None,  # gate projection output (one logit per expert)
+    "embed": None,
+    "layers": None,
+    "pos": None,
+}
+
+
+def logical_to_mesh_axes(logical_names: tuple, rules: dict) -> P:
+    """Translate a tuple of logical names into a PartitionSpec."""
+    return P(*(rules.get(name) for name in logical_names))
+
+
+def param_with_axes(init_fn, names: tuple):
+    """Box an initializer with logical partition names (flax metadata)."""
+    return nn.with_partitioning(init_fn, names)
+
+
+def cross_entropy_loss(
+    logits: jax.Array,           # (..., V)
+    labels: jax.Array,           # (...,) int
+    ignore_index: int = -100,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Mean token cross-entropy with ignore-index masking, fp32 softmax."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1).squeeze(-1)
+    nll = logz - label_logits
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(logz)
+    nll = jnp.where(valid, nll, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / count
+
+
+def shift_labels(input_ids: jax.Array, pad_id: int = -100) -> jax.Array:
+    """Next-token labels for causal LM: labels[t] = input_ids[t+1]."""
+    return jnp.concatenate(
+        [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], pad_id)], axis=1)
+
+
+class ModelOutput(dict):
+    """Attribute-accessible output dict (loss/logits/aux)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e

@@ -1,0 +1,262 @@
+"""GPT-2 model family, TPU-native.
+
+This is the flagship training model (BASELINE.json configs #1/#2/#5:
+GPT-2-125M DP smoke, GPT-2-1.5B ZeRO-2/3, GPT-2-XL 3D).  The reference has
+no model zoo for training — users bring torch models and DeepSpeed injects
+kernels (``module_inject/replace_policy.py:284`` ``HFGPT2LayerPolicy``
+records the q/k/v/mlp layout used here).  TPU-native, the model IS the
+integration point: parameters carry logical axis names (see
+``models/common.py``) so TP/FSDP fall out of a rules table, layers can be
+``nn.scan``-stacked (one compiled block, O(1) compile time in depth), and
+activation checkpointing is a ``jax.checkpoint`` policy on the block.
+
+Architecture parity: GPT-2 (pre-LN, gelu_new ≈ tanh-gelu, learned absolute
+positions, tied LM head, residual init scaled 1/√(2·n_layer)).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from .common import ModelOutput, cross_entropy_loss, shift_labels
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    embd_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+    resid_pdrop: float = 0.0
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16          # compute dtype
+    param_dtype: Any = jnp.float32     # storage dtype (master copy lives fp32)
+    scan_layers: bool = True           # nn.scan over blocks (fast compile)
+    remat: bool = False                # activation checkpointing per block
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"            # auto | jnp | flash | ring
+    vocab_pad_multiple: int = 128      # MXU/TP-friendly vocab padding
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+
+# Model sizes from the GPT-2/GPT-3 papers; XL(1.5B) is the north-star model.
+PRESETS = {
+    "gpt2-tiny": dict(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=2),
+    "gpt2-125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-350m": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-760m": dict(n_embd=1536, n_layer=24, n_head=16),
+    "gpt2-1.5b": dict(n_embd=1600, n_layer=48, n_head=25),
+}
+PRESETS["gpt2-xl"] = PRESETS["gpt2-1.5b"]
+
+
+def gpt2_config(preset: str = "gpt2-125m", **overrides) -> GPT2Config:
+    if preset not in PRESETS:
+        raise ValueError(f"unknown GPT-2 preset {preset!r}; valid: {sorted(PRESETS)}")
+    return GPT2Config(**{**PRESETS[preset], **overrides})
+
+
+def _dense(x, features, names, *, cfg: GPT2Config, name: str, module: nn.Module,
+           init_std: Optional[float] = None, use_bias: bool = True):
+    """Annotated dense layer: kernel gets logical axis names ``names``."""
+    std = cfg.initializer_range if init_std is None else init_std
+    kernel = module.param(
+        name + "_kernel",
+        nn.with_partitioning(nn.initializers.normal(std), names),
+        (x.shape[-1], features), cfg.param_dtype)
+    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if use_bias:
+        bias = module.param(name + "_bias",
+                            nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+                            (features,), cfg.param_dtype)
+        y = y + bias.astype(cfg.dtype)
+    return y
+
+
+class LayerNorm(nn.Module):
+    """fp32 layernorm with annotated scale/bias (reference fuses this in
+    ``csrc/transformer/normalize_kernels.cu``; XLA fuses it for us)."""
+
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_epsilon)
+        scale = self.param("scale", nn.with_partitioning(nn.initializers.ones, ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                          (x.shape[-1],), self.cfg.param_dtype)
+        return (y * scale + bias).astype(dtype)
+
+
+class SelfAttention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, attn_mask, deterministic: bool):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+        qkv = _dense(x, 3 * E, ("embed", "qkv"), cfg=cfg, name="c_attn", module=self)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
+        dropout_rng = None
+        if cfg.attn_pdrop > 0.0 and not deterministic:
+            dropout_rng = self.make_rng("dropout")
+        y = dot_product_attention(
+            q, k, v, causal=True, mask=attn_mask,
+            dropout_rate=0.0 if deterministic else cfg.attn_pdrop,
+            dropout_rng=dropout_rng, impl=cfg.attn_impl)
+        y = y.reshape(B, S, E)
+        out = _dense(y, E, ("heads", "embed"), cfg=cfg, name="c_proj", module=self,
+                     init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)
+        if cfg.resid_pdrop > 0.0 and not deterministic:
+            out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.cfg
+        h = _dense(x, 4 * cfg.n_embd, ("embed", "mlp"), cfg=cfg, name="c_fc", module=self)
+        h = nn.gelu(h, approximate=True)  # gelu_new
+        out = _dense(h, cfg.n_embd, ("mlp", "embed"), cfg=cfg, name="c_proj", module=self,
+                     init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)
+        if cfg.resid_pdrop > 0.0 and not deterministic:
+            out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
+        return out
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block; scan-compatible signature (carry, bcast).
+
+    ``deterministic`` is a static module attribute (not a traced input) so
+    remat/scan see a fixed program.
+    """
+
+    cfg: GPT2Config
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, attn_mask):
+        x = x + SelfAttention(self.cfg, name="attn")(
+            LayerNorm(self.cfg, name="ln_1")(x), attn_mask, self.deterministic)
+        x = x + MLP(self.cfg, name="mlp")(
+            LayerNorm(self.cfg, name="ln_2")(x), self.deterministic)
+        return x, None
+
+
+class GPT2LMHeadModel(nn.Module):
+    """Causal-LM GPT-2 with tied embeddings.
+
+    ``__call__(input_ids, labels=None, ...)`` returns a :class:`ModelOutput`
+    with ``logits`` (+ ``loss`` when labels given).  When ``labels`` is the
+    input shifted by the caller, pass it; otherwise pass
+    ``labels=input_ids`` and set ``shift=True`` (default) to compute
+    next-token loss.
+    """
+
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 labels=None, deterministic: bool = True, shift: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+
+        wte = self.param("wte", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")),
+            (cfg.padded_vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("pos", "embed")),
+            (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+
+        if position_ids is None:
+            position_ids = jnp.arange(S)[None, :]
+        h = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[position_ids]
+        if cfg.embd_pdrop > 0.0 and not deterministic:
+            h = nn.Dropout(cfg.embd_pdrop)(h, deterministic=False)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        if cfg.scan_layers:
+            block_cls = Block
+            if cfg.remat:
+                block_cls = nn.remat(
+                    Block, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                    prevent_cse=False, static_argnums=())
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+                in_axes=nn.broadcast,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )
+            h, _ = stack(cfg, deterministic, name="h")(h, mask)
+        else:
+            for i in range(cfg.n_layer):
+                block_cls = Block
+                if cfg.remat:
+                    block_cls = nn.remat(
+                        Block, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                        prevent_cse=False)
+                h, _ = block_cls(cfg, deterministic, name=f"h_{i}")(h, mask)
+
+        h = LayerNorm(cfg, name="ln_f")(h)
+        logits = jnp.dot(h, wte.astype(cfg.dtype).T)
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            # mask padded vocab columns out of the softmax
+            pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+            logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            tgt = shift_labels(labels) if shift else labels
+            out["loss"] = cross_entropy_loss(logits, tgt)
+        return out
+
+    # -- engine integration hooks ------------------------------------
+    def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
+        S = seq_len or min(self.cfg.n_positions, 128)
+        ids = jnp.zeros((batch_size, S), jnp.int32)
+        return {"input_ids": ids, "labels": ids}
+
+    def flops_per_token(self) -> float:
+        """6·N_params + attention flops, for MFU accounting."""
+        cfg = self.cfg
+        n_params = (cfg.padded_vocab_size * cfg.n_embd
+                    + cfg.n_positions * cfg.n_embd
+                    + cfg.n_layer * (12 * cfg.n_embd ** 2 + 13 * cfg.n_embd)
+                    + 2 * cfg.n_embd)
+        attn = 12 * cfg.n_layer * cfg.n_embd * cfg.n_positions
+        return 6.0 * n_params + attn

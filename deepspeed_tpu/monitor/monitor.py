@@ -1,0 +1,134 @@
+"""Experiment monitoring fan-out.
+
+Analog of reference ``deepspeed/monitor/monitor.py`` (``MonitorMaster``
+:24 fanning out to TensorBoard/W&B/CSV writers).  Same event contract:
+``write_events([(label, value, global_samples), ...])``, emitted only from
+process 0 (the reference gates on ``dist.get_rank() == 0``).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, Optional
+
+from ..runtime.config import MonitorConfig
+from ..utils.logging import logger
+
+
+class _CsvWriter:
+    """Reference ``monitor/csv_monitor.py`` analog: one CSV per label."""
+
+    def __init__(self, cfg: dict):
+        self.output_path = cfg.get("output_path", "csv_monitor/")
+        self.job_name = cfg.get("job_name", "DeepSpeedTPUJob")
+        self._files: dict[str, Any] = {}
+
+    def _file_for(self, label: str):
+        if label not in self._files:
+            d = os.path.join(self.output_path, self.job_name)
+            os.makedirs(d, exist_ok=True)
+            fh = open(os.path.join(d, label.replace("/", "_") + ".csv"), "a", newline="")
+            self._files[label] = (fh, csv.writer(fh))
+        return self._files[label]
+
+    def write_events(self, event_list):
+        for label, value, step in event_list:
+            fh, writer = self._file_for(label)
+            writer.writerow([int(step), float(value)])
+            fh.flush()
+
+    def close(self):
+        for fh, _ in self._files.values():
+            fh.close()
+        self._files.clear()
+
+
+class _TensorBoardWriter:
+    """Reference ``monitor/tensorboard.py`` analog (SummaryWriter-backed)."""
+
+    def __init__(self, cfg: dict):
+        output_path = cfg.get("output_path", "")
+        job_name = cfg.get("job_name", "DeepSpeedTPUJobName")
+        log_dir = os.path.join(output_path, "tensorboard", job_name)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+            except Exception:
+                logger.warning("tensorboard writer unavailable; disabling")
+                self.summary_writer = None
+                return
+        os.makedirs(log_dir, exist_ok=True)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list):
+        if self.summary_writer is None:
+            return
+        for label, value, step in event_list:
+            self.summary_writer.add_scalar(label, float(value), int(step))
+        self.summary_writer.flush()
+
+    def close(self):
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+
+
+class _WandbWriter:
+    """Reference ``monitor/wandb.py`` analog."""
+
+    def __init__(self, cfg: dict):
+        try:
+            import wandb  # noqa: F401
+
+            self.wandb = wandb
+            self.wandb.init(project=cfg.get("project"), group=cfg.get("group"),
+                            team=cfg.get("team"))
+        except Exception:
+            logger.warning("wandb unavailable; disabling")
+            self.wandb = None
+
+    def write_events(self, event_list):
+        if self.wandb is None:
+            return
+        for label, value, step in event_list:
+            self.wandb.log({label: float(value)}, step=int(step))
+
+    def close(self):
+        if self.wandb is not None:
+            self.wandb.finish()
+
+
+class MonitorMaster:
+    def __init__(self, config: MonitorConfig):
+        self.writers = []
+        self._rank0 = self._is_rank0()
+        if not self._rank0:
+            return
+        if config.tensorboard.get("enabled"):
+            self.writers.append(_TensorBoardWriter(config.tensorboard))
+        if config.wandb.get("enabled"):
+            self.writers.append(_WandbWriter(config.wandb))
+        if config.csv_monitor.get("enabled"):
+            self.writers.append(_CsvWriter(config.csv_monitor))
+
+    @staticmethod
+    def _is_rank0() -> bool:
+        try:
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.writers)
+
+    def write_events(self, event_list):
+        for w in self.writers:
+            w.write_events(event_list)
+
+    def close(self):
+        for w in self.writers:
+            w.close()

@@ -1,0 +1,86 @@
+"""Attention dispatcher: one API, multiple kernels.
+
+The reference ships attention as fused CUDA (training kernel
+``csrc/transformer/ds_transformer_cuda.cpp``; inference softmax w/
+triangular masking + KV-cache ``csrc/transformer/inference/csrc/softmax.cu``)
+and Triton block-sparse (``deepspeed/ops/sparse_attention/``).  Here the
+same surface dispatches between:
+
+- ``"jnp"``   — XLA-fused reference implementation (also the CPU-test path)
+- ``"flash"`` — Pallas flash-attention kernel (``ops/pallas/flash_attention.py``)
+- ``"auto"``  — flash on TPU when shapes allow, else jnp
+
+Shapes follow the JAX convention ``(batch, seq, heads, head_dim)``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_impl(impl: str, q) -> str:
+    if impl != "auto":
+        return impl
+    # flash kernel needs TPU + seq/head_dim tiling; fall back otherwise
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu and q.shape[1] >= 128 and q.shape[3] in (64, 128, 256):
+        return "flash"
+    return "jnp"
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,  # (B, T, H, D)
+    v: jax.Array,  # (B, T, H, D)
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,       # broadcastable to (B, H, S, T)
+    mask: Optional[jax.Array] = None,       # bool, True = attend
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Multi-head scaled dot-product attention; returns ``(B, S, H, D)``."""
+    impl = _pick_impl(impl, q)
+    if impl == "flash" and bias is None and mask is None and dropout_rate == 0.0:
+        try:
+            from .pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:  # missing kernel support on this backend/shape
+            impl = "jnp"
+    return _jnp_attention(q, k, v, causal=causal, bias=bias, mask=mask,
+                          dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                          scale=scale)
+
+
+def _jnp_attention(q, k, v, *, causal, bias, mask, dropout_rate, dropout_rng, scale):
+    _, s_q, _, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    # fp32 softmax for stability (the reference kernel does fp32 accumulation
+    # in its fused softmax, softmax_kernels.cu)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    neg = jnp.finfo(scores.dtype).min
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(causal_mask[None, None, :, :], scores, neg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)

@@ -1,0 +1,1 @@
+from . import zero  # noqa: F401

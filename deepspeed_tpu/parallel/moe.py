@@ -1,0 +1,225 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Analog of ``deepspeed/moe/`` (``MoE`` layer ``layer.py:18``, ``TopKGate``
+``sharded_moe.py:352``, ``MOELayer`` ``sharded_moe.py:440``, all-to-all
+autograd shim ``sharded_moe.py:90``, expert/data group math
+``utils/groups.py:108``).  TPU-native design:
+
+- The gating math (top-1/top-2, capacity, jitter, load-balancing aux loss)
+  ports almost 1:1 — it was always einsum-shaped (GShard lineage).
+- The explicit ``_AllToAll`` + expert process groups disappear: expert
+  parameters carry a leading ``experts`` dim sharded on the ``ep`` mesh
+  axis, the dispatched token tensor is sharding-constrained to the same
+  axis, and XLA inserts the all-to-all pair (dispatch + combine) that the
+  reference issues by hand (``sharded_moe.py:513,527``).
+- Expert-vs-data group bookkeeping (``_create_expert_and_data_parallel``)
+  is unnecessary: ``ep`` is one of the batch axes (see ``mesh.DATA_AXES``),
+  so non-expert params are automatically replicated over it and expert
+  grads are automatically reduced only across the right ranks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 1                      # 1 or 2 (reference top1gating/top2gating)
+    capacity_factor: float = 1.0        # train capacity (sharded_moe.py:178)
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None   # None | 'Jitter' | 'RSample'
+    aux_loss_weight: float = 0.01
+    drop_tokens: bool = True
+    use_residual: bool = False          # PR-MoE (layer.py:106)
+
+
+def _capacity(num_tokens: int, num_experts: int, factor: float, min_capacity: int) -> int:
+    cap = int(num_tokens * factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def top1_gating(logits: jax.Array, capacity: int, rng=None,
+                noise_policy: Optional[str] = None
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 gating (reference ``sharded_moe.py:178`` lineage).
+
+    Returns ``(l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C])``.
+    """
+    S, E = logits.shape
+    if noise_policy == "RSample" and rng is not None:
+        logits_for_choice = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_for_choice = logits
+    gates = jax.nn.softmax(logits, axis=-1)                       # (S, E)
+    expert_idx = jnp.argmax(logits_for_choice, axis=-1)           # (S,)
+    mask1 = _one_hot(expert_idx, E)                               # (S, E)
+
+    # position of each token within its expert's queue
+    pos_in_expert = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1     # (S, E)
+    keep = (pos_in_expert < capacity).astype(jnp.float32) * mask1
+
+    # load-balancing aux loss: E * sum_e( fraction_tokens_e * mean_gate_e )
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    gate_val = (gates * keep).sum(axis=-1, keepdims=True)         # (S, 1)
+    pos = (pos_in_expert * keep).sum(axis=-1).astype(jnp.int32)   # (S,)
+    pos_oh = _one_hot(pos, capacity)                              # (S, C)
+    combine = (gate_val * keep)[:, :, None] * pos_oh[:, None, :]  # (S, E, C)
+    dispatch = combine > 0.0
+    return l_aux, combine, dispatch
+
+
+def top2_gating(logits: jax.Array, capacity: int, rng=None,
+                noise_policy: Optional[str] = None
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-2 gating with 2nd-choice jitter (reference ``sharded_moe.py:279``)."""
+    S, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    logits_wo_1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    if noise_policy == "RSample" and rng is not None:
+        logits_wo_1 = logits_wo_1 + jax.random.gumbel(rng, logits.shape)
+    idx2 = jnp.argmax(logits_wo_1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1
+    # second choices queue behind ALL first choices (reference :318)
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1.0) * mask2 + mask1.sum(axis=0, keepdims=True) * mask2
+    keep1 = (pos1 < capacity).astype(jnp.float32) * mask1
+    keep2 = (pos2 < capacity).astype(jnp.float32) * mask2
+
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    g1 = (gates * keep1).sum(-1)
+    g2 = (gates * keep2).sum(-1)
+    denom = jnp.maximum(g1 + g2, jnp.finfo(gates.dtype).eps)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = (pos1 * keep1).sum(-1).astype(jnp.int32)
+    p2 = (pos2 * keep2).sum(-1).astype(jnp.int32)
+    combine = (g1[:, None] * keep1)[:, :, None] * _one_hot(p1, capacity)[:, None, :] \
+        + (g2[:, None] * keep2)[:, :, None] * _one_hot(p2, capacity)[:, None, :]
+    dispatch = combine > 0.0
+    return l_aux, combine, dispatch
+
+
+class TopKGate(nn.Module):
+    """Gate module (reference ``sharded_moe.py:352``): fp32 linear + top-k."""
+
+    cfg: MoEConfig
+    model_dim: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool):
+        cfg = self.cfg
+        wg = self.param("wg", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("embed", "experts_gate")),
+            (self.model_dim, cfg.num_experts), jnp.float32)
+        xf = x.astype(jnp.float32)
+        if train and cfg.noisy_gate_policy == "Jitter":
+            rng = self.make_rng("gating")
+            xf = xf * jax.random.uniform(rng, xf.shape, minval=0.98, maxval=1.02)
+        logits = xf @ wg
+        S = logits.shape[0]
+        factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
+        capacity = _capacity(S, cfg.num_experts, factor, cfg.min_capacity)
+        rng = self.make_rng("gating") if (train and cfg.noisy_gate_policy == "RSample") else None
+        if cfg.top_k == 1:
+            return top1_gating(logits, capacity, rng, cfg.noisy_gate_policy)
+        if cfg.top_k == 2:
+            return top2_gating(logits, capacity, rng, cfg.noisy_gate_policy)
+        raise ValueError(f"top_k must be 1 or 2, got {cfg.top_k}")
+
+
+class ExpertsMLP(nn.Module):
+    """E parallel FFNs with a leading expert dim sharded on ``ep``."""
+
+    num_experts: int
+    model_dim: int
+    hidden_dim: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:   # (E, C, M)
+        wi = self.param("wi", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("experts", "embed", "mlp")),
+            (self.num_experts, self.model_dim, self.hidden_dim), self.param_dtype)
+        wo = self.param("wo", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("experts", "mlp", "embed")),
+            (self.num_experts, self.hidden_dim, self.model_dim), self.param_dtype)
+        h = jnp.einsum("ecm,emh->ech", x, wi.astype(self.dtype))
+        h = nn.gelu(h, approximate=True)
+        return jnp.einsum("ech,ehm->ecm", h, wo.astype(self.dtype))
+
+
+class MoELayer(nn.Module):
+    """Drop-in MoE FFN (reference ``MOELayer`` ``sharded_moe.py:440`` +
+    ``MoE`` wrapper ``layer.py:18``).
+
+    Input ``(..., model_dim)`` → output ``(..., model_dim)``; also returns
+    the aux loss.  The dispatched tensor is constrained to the ``ep`` axis,
+    making XLA emit the all-to-all pair on ICI.
+    """
+
+    cfg: MoEConfig
+    model_dim: int
+    hidden_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False):
+        cfg = self.cfg
+        orig_shape = x.shape
+        x2 = x.reshape(-1, self.model_dim)                        # (S, M)
+        l_aux, combine, dispatch = TopKGate(cfg, self.model_dim, name="gate")(x2, train)
+
+        dispatched = jnp.einsum("sec,sm->ecm", dispatch.astype(self.dtype), x2)
+        dispatched = _constrain_ep(dispatched)                    # all-to-all in
+        expert_out = ExpertsMLP(cfg.num_experts, self.model_dim, self.hidden_dim,
+                                dtype=self.dtype, name="experts")(dispatched)
+        expert_out = _constrain_ep(expert_out)                    # all-to-all out
+        out = jnp.einsum("sec,ecm->sm", combine.astype(self.dtype), expert_out)
+
+        if cfg.use_residual:
+            # PR-MoE: dense MLP branch + learned 2-way mix (layer.py:106-125)
+            from ..models.gpt2 import GPT2Config  # avoid cycle at module load
+
+            dense = nn.Dense(self.hidden_dim, dtype=self.dtype, name="residual_fc1")(x2)
+            dense = nn.gelu(dense, approximate=True)
+            dense = nn.Dense(self.model_dim, dtype=self.dtype, name="residual_fc2")(dense)
+            coef = nn.Dense(2, dtype=self.dtype, name="coefficient")(x2)
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1] + dense * coef[..., 1:2]
+
+        return out.reshape(orig_shape), l_aux * cfg.aux_loss_weight
+
+
+def _constrain_ep(x: jax.Array) -> jax.Array:
+    """Pin the leading (expert) dim to the ``ep`` axis if a mesh is active."""
+    mesh = mesh_lib.get_mesh(required=False)
+    if mesh is None or mesh.shape.get("ep", 1) == 1:
+        return x
+    from jax.sharding import NamedSharding
+
+    spec = P("ep", *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

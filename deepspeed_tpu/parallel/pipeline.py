@@ -1,0 +1,115 @@
+"""Pipeline parallelism as ONE compiled systolic loop.
+
+Reference mechanism (``runtime/pipe/``, 4.1k LoC): a Python interpreter
+walks an instruction stream (``engine.py:1359 _exec_schedule``) issuing
+eager forward/backward calls and p2p send/recvs (``p2p.py:48,69``) with a
+meta-shape handshake (``engine.py:829``).  TPU-native, the whole schedule
+compiles into a single ``lax.scan``:
+
+- the layer stack is stacked on a leading ``layers`` dim and sharded over
+  the ``pp`` mesh axis — each stage physically holds ``L/S`` layers;
+- each scan tick, every stage runs its local sub-stack on its current
+  activation buffer and ``ppermute``s the result one hop down the ring
+  (p2p with no handshake — shapes are static);
+- microbatch ``t`` enters at stage 0 on tick ``t`` and exits at stage
+  ``S-1`` on tick ``t+S-1``, where its loss is accumulated;
+- ``jax.grad`` of the loop IS the backward schedule (reverse systolic
+  wave) — no instruction interpreter exists to write.
+
+Embedding/head ("shared") params are replicated across ``pp`` (their
+cotangents get the automatic psum from shard_map transposition — the
+tied-weight grad sync of ``pipe/module.py:419``).  Data axes (dp/fsdp/...)
+stay AUTOMATIC: the shard_map is entered only for ``pp``, composing PP
+with ZeRO/TP sharding handled by XLA.
+
+Schedule-shape reference lives in ``schedule.py`` (GPipe/1F1B math).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pvary(x, axis):
+    return jax.tree_util.tree_map(
+        lambda l: lax.pcast(l, (axis,), to="varying"), x)
+
+
+def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
+               *, embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
+               axis: str = "pp") -> jax.Array:
+    """Mean loss over M microbatches, pipelined over ``axis``.
+
+    Must run where ``axis`` is a MANUAL (shard_map) axis.
+
+    - ``microbatches``: pytree with leading dim M (microbatch index);
+      leaves replicated across ``axis``.
+    - ``embed_fn(shared, mb) -> h``: tokens → hidden (stage-0 work,
+      computed redundantly everywhere — cheap, keeps SPMD).
+    - ``stage_fn(stage_params_local, h) -> h``: one stage's layer sub-stack.
+    - ``loss_fn(shared, h, mb) -> scalar``: final-norm + head + loss.
+    """
+    S = lax.axis_size(axis)
+    sid = lax.axis_index(axis)
+    leaves = jax.tree_util.tree_leaves(microbatches)
+    M = leaves[0].shape[0]
+    T = M + S - 1
+
+    def pick_mb(t):
+        idx = jnp.clip(t, 0, M - 1)
+        return jax.tree_util.tree_map(
+            lambda x: lax.dynamic_index_in_dim(x, idx, 0, keepdims=False),
+            microbatches)
+
+    mb0 = pick_mb(jnp.int32(0))
+    h_shape = jax.eval_shape(lambda: embed_fn(shared_params, mb0))
+    x0 = _pvary(jnp.zeros(h_shape.shape, h_shape.dtype), axis)
+    loss0 = _pvary(jnp.zeros((), jnp.float32), axis)
+
+    def tick(carry, t):
+        x_buf, loss_acc = carry
+        # stage 0 ingests microbatch t (garbage after t >= M, masked below)
+        mb_in = pick_mb(t)
+        h_in = embed_fn(shared_params, mb_in)
+        x = jnp.where(sid == 0, h_in, x_buf)
+        y = stage_fn(stage_params, x)
+        # last stage emits microbatch t-(S-1) when valid
+        out_t = t - (S - 1)
+        mb_out = pick_mb(out_t)
+        mb_loss = loss_fn(shared_params, y, mb_out)
+        valid = jnp.logical_and(sid == S - 1,
+                                jnp.logical_and(out_t >= 0, out_t < M))
+        loss_acc = loss_acc + jnp.where(valid, mb_loss, 0.0)
+        x_next = lax.ppermute(y, axis, [(i, (i + 1) % S) for i in range(S)])
+        return (x_next, loss_acc), None
+
+    (x_fin, loss_sum), _ = lax.scan(tick, (x0, loss0), jnp.arange(T))
+    # only the last stage accumulated real losses; share with the ring
+    return lax.psum(loss_sum, axis) / M
+
+
+def pipeline_spmd_loss(mesh, shared_params, stage_params, microbatches, *,
+                       embed_fn, stage_fn, loss_fn,
+                       stage_params_layer_dim_spec, axis: str = "pp"):
+    """Wrap :func:`gpipe_loss` in a shard_map that is manual ONLY over
+    ``pp`` — every other mesh axis stays automatic so ZeRO/TP/DP sharding
+    composes (XLA keeps handling those collectives inside each stage).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    other = frozenset(n for n in mesh.axis_names if n != axis)
+
+    fn = functools.partial(gpipe_loss, embed_fn=embed_fn, stage_fn=stage_fn,
+                           loss_fn=loss_fn, axis=axis)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(), stage_params_layer_dim_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+        auto=other,
+    )(shared_params, stage_params, microbatches)

@@ -1,0 +1,137 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference (v0.6.6) has NO sequence-parallel axis — its long-sequence
+story is Triton block-sparse attention (``ops/sparse_attention/``) plus
+curriculum seqlen (SURVEY.md §2.2 SP row).  For this framework SP is a
+first-class subsystem: the sequence dim of activations is sharded on
+``sp``, attention runs as a ring — each step combines the local KV block
+with a running online-softmax accumulator, then rotates the KV shard one
+hop around the ring with ``lax.ppermute`` (ICI-neighbour traffic only,
+overlapped with the block computation by XLA's latency-hiding scheduler).
+
+Math: standard online softmax (flash-attention accumulator) across ring
+steps — numerically identical to full attention, memory O(seq/sp) per chip.
+Causal masking uses the block indices: a KV block strictly in the future of
+the whole Q block is skipped-by-masking (its contribution multiplies to
+exp(-inf)=0), so the program stays static-shaped.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, acc, m, l, *, scale, mask_fn):
+    """Accumulate one KV block into the online-softmax state.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D)
+    acc: (B, Sq, H, D) unnormalized numerator; m/l: (B, H, Sq) running
+    max / denominator.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = mask_fn(s)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (m_new = -inf): keep them contributing zero
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])                      # (B,H,Sq,Sk)
+    correction = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    MUST run inside ``shard_map`` (or any context where ``axis_name`` is
+    bound).  Inputs are the LOCAL sequence shards ``(B, S_local, H, D)``;
+    output is the local shard of the attention result.  Block layout
+    assumes sequence order = ring order (shard i holds tokens
+    ``[i·S_local, (i+1)·S_local)``).
+    """
+    B, S, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = D ** -0.5
+    neg_inf = jnp.float32(jnp.finfo(jnp.float32).min)
+
+    q32 = q
+    # initial accumulators are constants; mark them device-varying so the
+    # scan carry type is stable under shard_map's varying-axis typing
+    pvary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    acc0 = pvary(jnp.zeros((B, S, H, D), jnp.float32))
+    m0 = pvary(jnp.full((B, H, S), -jnp.inf, jnp.float32))
+    l0 = pvary(jnp.zeros((B, H, S), jnp.float32))
+
+    def mask_for(kv_idx):
+        # global positions: q row r -> my_idx*S + r; kv col c -> kv_idx*S + c
+        if not causal:
+            return lambda s: s
+        q_pos = my_idx * S + jnp.arange(S)
+        k_pos = kv_idx * S + jnp.arange(S)
+        causal_mask = q_pos[:, None] >= k_pos[None, :]
+
+        def apply(s):
+            return jnp.where(causal_mask[None, None], s, neg_inf)
+
+        return apply
+
+    def body(carry, _):
+        acc, m, l, kv, kv_idx = carry
+        k_blk, v_blk = kv
+        acc, m, l = _block_attend(q32, k_blk, v_blk, acc, m, l,
+                                  scale=scale, mask_fn=mask_for(kv_idx))
+        # rotate KV one hop: shard i sends to i+1, so we RECEIVE shard
+        # (my_idx - step - 1); equivalently kv_idx decrements mod n
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kv = jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis_name, perm), kv)
+        kv_idx = (kv_idx - 1) % n
+        return (acc, m, l, kv, kv_idx), None
+
+    init = (acc0, m0, l0, (k, v), my_idx)
+    (acc, m, l, _, _), _ = lax.scan(body, init, None, length=n)
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str = "sp", causal: bool = True,
+                      scale: Optional[float] = None,
+                      attend_fn=None) -> jax.Array:
+    """Ulysses-style SP: all-to-all scatter heads / gather sequence.
+
+    DeepSpeed-Ulysses (post-reference-version feature, built here for
+    long-context parity): inputs sharded on sequence; two ``all_to_all``s
+    re-shard to head-parallel so each rank runs FULL-sequence attention on
+    ``H/n`` heads, then the inverse all-to-all restores sequence sharding.
+    Requires ``H % axis_size == 0``.  Must run inside ``shard_map``.
+    """
+    B, S, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by sp axis size {n}")
+
+    def seq_to_heads(x):  # (B, S_loc, H, D) -> (B, S_glob, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attend_fn is None:
+        from ..ops.attention import _jnp_attention
+
+        attend_fn = partial(_jnp_attention, bias=None, mask=None,
+                            dropout_rate=0.0, dropout_rng=None)
+    out = attend_fn(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)

@@ -1,0 +1,176 @@
+"""Pipeline schedule generation (pure math, no devices).
+
+Parity analog of reference ``runtime/pipe/schedule.py`` (``TrainSchedule``
+:182, ``InferenceSchedule``, instruction classes) — there, the schedule is
+an instruction stream interpreted per-step by a Python loop
+(``pipe/engine.py:1359 _exec_schedule``).  Here the execution is ONE
+compiled systolic loop (see ``pipeline.py``), so the instruction stream's
+runtime role disappears; this module keeps the schedule math because it is
+(a) the spec the compiled loop implements, (b) used by tests to check
+bubble/step counts, and (c) useful for visualizing utilization.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Instruction:
+    name: str
+    micro_batch_id: int = -1
+
+    def __repr__(self):
+        if self.micro_batch_id >= 0:
+            return f"{self.name}(mb={self.micro_batch_id})"
+        return self.name
+
+
+def _instr(name):
+    def make(mb=-1):
+        return Instruction(name, mb)
+
+    return make
+
+
+LoadMicroBatch = _instr("LoadMicroBatch")
+ForwardPass = _instr("ForwardPass")
+BackwardPass = _instr("BackwardPass")
+SendActivation = _instr("SendActivation")
+RecvActivation = _instr("RecvActivation")
+SendGrad = _instr("SendGrad")
+RecvGrad = _instr("RecvGrad")
+ReduceGrads = _instr("ReduceGrads")
+OptimizerStep = _instr("OptimizerStep")
+
+
+class PipeSchedule:
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {stages} stages")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self) -> int:
+        raise NotImplementedError
+
+    def steps(self) -> Iterator[List[Instruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+
+class GPipeSchedule(PipeSchedule):
+    """All-forward-then-all-backward (what autodiff of the systolic forward
+    loop produces).  Total ticks = 2·(M + S - 1); bubble fraction
+    (S-1)/(M+S-1) per phase."""
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def total_ticks(self) -> int:
+        return 2 * (self.micro_batches + self.stages - 1)
+
+    def steps(self):
+        M, S, sid = self.micro_batches, self.stages, self.stage_id
+        fwd_ticks = M + S - 1
+        for t in range(fwd_ticks):
+            cmds: List[Instruction] = []
+            mb = t - sid
+            if 0 <= mb < M:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(mb))
+                else:
+                    cmds.append(RecvActivation(mb))
+                cmds.append(ForwardPass(mb))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(mb))
+            yield cmds
+        for t in range(fwd_ticks):
+            cmds = []
+            # backward wave enters from the LAST stage
+            mb = M - 1 - (t - (S - 1 - sid))
+            if 0 <= t - (S - 1 - sid) < M:
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(mb))
+                cmds.append(BackwardPass(mb))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(mb))
+            yield cmds
+        yield [ReduceGrads(), OptimizerStep()]
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B interleave (reference ``schedule.py:189-291`` semantics):
+    steady-state alternates one forward with one backward, bounding live
+    activations at ``min(M, S)`` instead of ``M``."""
+
+    def num_pipe_buffers(self) -> int:
+        return min(self.micro_batches, self.stages - self.stage_id + 1) \
+            if self.micro_batches >= self.stages else self.micro_batches
+
+    def steps(self):
+        M, S, sid = self.micro_batches, self.stages, self.stage_id
+        warmup = min(S - sid - 1, M)
+        fwd_done = bwd_done = 0
+        # warmup: forwards only
+        for _ in range(warmup):
+            cmds = []
+            cmds.append(LoadMicroBatch(fwd_done) if self.is_first_stage
+                        else RecvActivation(fwd_done))
+            cmds.append(ForwardPass(fwd_done))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(fwd_done))
+            fwd_done += 1
+            yield cmds
+        # steady state: 1F1B
+        while bwd_done < M:
+            cmds = []
+            if fwd_done < M:
+                cmds.append(LoadMicroBatch(fwd_done) if self.is_first_stage
+                            else RecvActivation(fwd_done))
+                cmds.append(ForwardPass(fwd_done))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(fwd_done))
+                fwd_done += 1
+            if not self.is_last_stage:
+                cmds.append(RecvGrad(bwd_done))
+            cmds.append(BackwardPass(bwd_done))
+            if not self.is_first_stage:
+                cmds.append(SendGrad(bwd_done))
+            bwd_done += 1
+            yield cmds
+        yield [ReduceGrads(), OptimizerStep()]
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only wave (reference ``schedule.py`` InferenceSchedule)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        M, S, sid = self.micro_batches, self.stages, self.stage_id
+        for t in range(M + S - 1):
+            cmds: List[Instruction] = []
+            mb = t - sid
+            if 0 <= mb < M:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(mb))
+                else:
+                    cmds.append(RecvActivation(mb))
+                cmds.append(ForwardPass(mb))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(mb))
+            yield cmds

@@ -1,0 +1,176 @@
+"""ZeRO as sharding policy.
+
+The reference implements ZeRO with ~7k lines of imperative partition
+bookkeeping (``runtime/zero/stage_1_and_2.py``, ``stage3.py``,
+``partition_parameters.py``, ``partitioned_param_coordinator.py``): flatten
+params into per-rank flat buffers, hook every grad, bucket + reduce-scatter
+on side streams, allgather updated partitions, trace module execution to
+prefetch.  On TPU every one of those mechanisms is a *sharding decision*
+handed to XLA:
+
+=======  =====================================  ==============================
+stage    reference mechanism                    TPU-native policy
+=======  =====================================  ==============================
+0        bucketed grad allreduce                grads psum'd by XLA (pure DP)
+1        optimizer-state partitions (:1425)     opt-state leaves sharded on
+                                                ``fsdp``; XLA reduce-scatters
+                                                grads into the update and
+                                                all-gathers new params
+2        + grad partitions w/ hooks (:783)      + grad-accumulation buffer
+                                                sharded on ``fsdp``
+3        + param partitions, per-module         + params sharded on ``fsdp``;
+         gather/release + prefetch              XLA all-gathers per layer
+         (stage3.py:1084, coordinator)          inside the scanned block and
+                                                frees after use (remat scan =
+                                                the "coordinator")
+=======  =====================================  ==============================
+
+``zero.Init`` (``partition_parameters.py:529`` — monkey-patching
+``nn.Module.__init__`` to shard at construction) becomes: initialize under
+``jax.jit`` with sharded ``out_shardings``, so full params NEVER
+materialize on one device.  No patching required.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.common import TP_RULES
+from ..utils.logging import logger
+
+
+def _axis_size(mesh, axis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        size = 1
+        for a in axis:
+            size *= mesh.shape[a]
+        return size
+    return mesh.shape[axis]
+
+
+def add_fsdp_to_spec(spec: P, shape: tuple, mesh, axis: str = "fsdp") -> P:
+    """Add the ``fsdp`` mesh axis to the best-fitting dim of ``spec``.
+
+    Picks the largest dim whose size is divisible by fsdp×(already-assigned
+    axes); leaves the spec unchanged if nothing fits (small params stay
+    replicated — the same params the reference keeps in
+    ``persistent_parameters``, ``stage3.py`` param-persistence threshold).
+    """
+    fsdp_size = mesh.shape[axis]
+    if fsdp_size == 1 or not shape:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    best_dim, best_size = None, 0
+    for d, dim_size in enumerate(shape):
+        existing = entries[d]
+        if existing is not None:
+            existing_axes = existing if isinstance(existing, tuple) else (existing,)
+            if axis in existing_axes:
+                return spec
+            divisor = _axis_size(mesh, existing_axes) * fsdp_size
+        else:
+            divisor = fsdp_size
+        if dim_size % divisor == 0 and dim_size > best_size:
+            best_dim, best_size = d, dim_size
+    if best_dim is None:
+        return spec
+    existing = entries[best_dim]
+    if existing is None:
+        entries[best_dim] = axis
+    else:
+        existing_axes = existing if isinstance(existing, tuple) else (existing,)
+        entries[best_dim] = (*existing_axes, axis)
+    return P(*entries)
+
+
+def logical_spec(leaf) -> P:
+    """PartitionSpec of logical names from a flax ``Partitioned`` box (or P())."""
+    names = getattr(leaf, "names", None)
+    if names is None:
+        return P()
+    return P(*names)
+
+
+def resolve_tp(spec: P, shape: tuple, mesh, rules: dict) -> P:
+    """Map logical names → mesh axes through ``rules``, with divisibility checks."""
+    entries = []
+    for d, name in enumerate(spec):
+        axis = rules.get(name) if name is not None else None
+        if axis is not None:
+            size = _axis_size(mesh, axis)
+            if d < len(shape) and shape[d] % size != 0:
+                raise ValueError(
+                    f"param dim {d} (logical {name!r}, size {shape[d]}) not divisible "
+                    f"by mesh axis {axis!r} size {size}")
+        entries.append(axis)
+    return P(*entries)
+
+
+def param_partition_specs(abstract_params, mesh, zero_stage: int,
+                          rules: Optional[dict] = None):
+    """PartitionSpec tree for *parameters* given ZeRO stage + TP rules.
+
+    ``abstract_params``: pytree of ShapeDtypeStruct, possibly boxed in
+    ``flax.linen.Partitioned`` metadata carrying logical axis names.
+    """
+    rules = dict(TP_RULES if rules is None else rules)
+
+    def spec_for(leaf) -> P:
+        value = getattr(leaf, "value", leaf)  # unbox Partitioned
+        shape = np.shape(value) if not hasattr(value, "shape") else value.shape
+        spec = resolve_tp(logical_spec(leaf), shape, mesh, rules)
+        if zero_stage >= 3:
+            spec = add_fsdp_to_spec(spec, shape, mesh)
+        return spec
+
+    return jax.tree_util.tree_map(
+        spec_for, abstract_params,
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+
+
+def shard_like_stage3(abstract_params, mesh, rules: Optional[dict] = None):
+    """Stage-3-style specs regardless of configured stage — used for
+    optimizer-state (stage ≥1) and grad-accumulator (stage ≥2) placement."""
+    return param_partition_specs(abstract_params, mesh, zero_stage=3, rules=rules)
+
+
+def opt_state_specs(optimizer, abstract_params, param_like_specs):
+    """PartitionSpec tree for the optax state.
+
+    Param-shaped leaves (Adam mu/nu, …) follow ``param_like_specs``;
+    scalars (step counts) replicate.  This is the whole of the reference's
+    optimizer-state partitioning (``stage_1_and_2.py:1425``
+    ``_partition_base_optimizer_state``).
+    """
+    import optax
+
+    unboxed = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x), abstract_params,
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    abstract_opt = jax.eval_shape(optimizer.init, unboxed)
+    return optax.tree_map_params(
+        optimizer,
+        lambda _, spec: spec,
+        abstract_opt,
+        param_like_specs,
+        transform_non_params=lambda _: P(),
+    )
+
+
+def named_shardings(mesh, spec_tree):
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), spec_tree,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def validate_stage_mesh(zero_stage: int, mesh) -> None:
+    if zero_stage >= 1 and mesh.shape["fsdp"] == 1 and mesh.shape["dp"] > 1:
+        logger.warning(
+            f"ZeRO stage {zero_stage} requested but mesh has fsdp=1, dp="
+            f"{mesh.shape['dp']}: optimizer/param sharding will be a no-op. "
+            "Put data-parallel devices on the 'fsdp' axis (the engine does "
+            "this automatically when it builds the mesh).")

@@ -1,0 +1,1 @@
+from .config import Config, DeepSpeedConfig  # noqa: F401

@@ -1,0 +1,85 @@
+"""Data loading.
+
+Analog of reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader`` :10,
+``RepeatingLoader`` :33) and the engine's ``deepspeed_io`` wiring
+(``engine.py:1457``).  Single-controller difference: the reference pairs a
+per-rank sampler with N processes; here ONE process iterates *global
+micro-batches* (``micro_batch × dp_world`` rows) and the engine shards them
+onto the mesh (multi-host: each host feeds its local shard via
+``jax.make_array_from_process_local_data``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+def _stack(samples: list) -> Any:
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: _stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_stack([s[i] for s in samples]) for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into global micro-batches.
+
+    ``dataset``: sequence of samples (dict / tuple / array).  ``batch_size``
+    is the GLOBAL micro-batch (``train_micro_batch_size_per_gpu × dp_world``).
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _stack
+        self._epoch = 0
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + self._epoch).permutation(n)
+        for start in range(0, n, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+
+class RepeatingLoader:
+    """Infinitely recycle a loader (reference ``dataloader.py:33``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "_epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

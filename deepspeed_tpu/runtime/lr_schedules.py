@@ -1,0 +1,115 @@
+"""LR schedules — the reference's four (``runtime/lr_schedules.py``:
+``LRRangeTest`` :310, ``OneCycle`` :417, ``WarmupLR`` :706,
+``WarmupDecayLR`` :802) as pure step→lr functions (optax-schedule shaped),
+accepting the same JSON ``params`` vocabulary.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
+    """Reference ``lr_schedules.py:706``: warm from min→max then hold."""
+    warmup_num_steps = max(warmup_num_steps, 2)
+
+    def sched(step):
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            # log-spaced interpolation: min * (max/min)^frac, guarding min=0
+            lo = max(warmup_min_lr, 1e-10 * warmup_max_lr)
+            factor = jnp.log(jnp.maximum(step, 1)) / math.log(warmup_num_steps)
+            lr = lo * (warmup_max_lr / lo) ** jnp.clip(factor, 0.0, 1.0)
+        else:
+            lr = warmup_min_lr + frac * (warmup_max_lr - warmup_min_lr)
+        return jnp.where(step >= warmup_num_steps, warmup_max_lr, lr)
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_) -> Schedule:
+    """Reference ``lr_schedules.py:802``: warmup then linear decay to 0."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps = max(warmup_num_steps, 2)
+
+    def sched(step):
+        decay_frac = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, warm(step), warmup_max_lr * decay_frac)
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0, **_) -> Schedule:
+    """Reference ``lr_schedules.py:417``: triangular cycle then optional decay.
+
+    (Momentum cycling from the reference is handled by the optimizer builder
+    when ``cycle_momentum`` is set; the lr leg lives here.)
+    """
+    second = cycle_second_step_size or cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def sched(step):
+        up_frac = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down_frac = jnp.clip((step - cycle_first_step_size) / second, 0.0, 1.0)
+        in_cycle_lr = jnp.where(
+            step <= cycle_first_step_size,
+            cycle_min_lr + up_frac * (cycle_max_lr - cycle_min_lr),
+            cycle_max_lr - down_frac * (cycle_max_lr - cycle_min_lr))
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - total_cycle, 0) / decay_step_size
+            decayed = cycle_min_lr / (1.0 + decay_steps * decay_lr_rate)
+            return jnp.where(step > total_cycle, decayed, in_cycle_lr)
+        return in_cycle_lr
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """Reference ``lr_schedules.py:310``: LR sweep for tuning."""
+
+    def sched(step):
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+_BUILDERS = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def get_lr_schedule(name: Optional[str], params: dict,
+                    base_lr: float = 1e-3) -> Optional[Schedule]:
+    """Build a schedule from config; None name → constant ``base_lr``."""
+    if name is None:
+        return lambda step: jnp.float32(base_lr)
+    if name not in _BUILDERS:
+        raise ValueError(f"unknown scheduler {name!r}; valid: {VALID_LR_SCHEDULES}")
+    return _BUILDERS[name](**params)

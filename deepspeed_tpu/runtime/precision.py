@@ -1,0 +1,68 @@
+"""Mixed-precision policy + functional dynamic loss scaling.
+
+Analog of the reference fp16 stack (``runtime/fp16/loss_scaler.py``
+``DynamicLossScaler``; ``fused_optimizer.py:19`` ``FP16_Optimizer``;
+``bf16_optimizer.py:75``).  TPU-native differences:
+
+- bf16 is the default compute dtype; it needs NO loss scaling (same as the
+  reference's BF16_Optimizer) — master weights stay fp32 and models cast
+  per-use, so there is no separate bf16 parameter copy to keep in sync.
+- fp16 mode keeps the reference's dynamic-scale state machine (grow after
+  ``loss_scale_window`` clean steps, shrink ×0.5 on overflow with
+  hysteresis), but as a pure function inside the compiled train step:
+  overflow check is a ``jnp.isfinite`` all-reduce and the skip-step is a
+  ``lax.cond`` — no host round-trip, unlike ``has_overflow``'s blocking
+  allreduce (``stage_1_and_2.py:2461``).
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .config import Float16Config
+
+
+@flax.struct.dataclass
+class LossScaleState:
+    scale: jax.Array          # f32 scalar
+    good_steps: jax.Array     # i32: consecutive overflow-free steps
+    hysteresis: jax.Array     # i32: remaining tolerated overflows before shrink
+
+
+def init_loss_scale(cfg: Float16Config) -> LossScaleState:
+    if not cfg.enabled:
+        return LossScaleState(scale=jnp.float32(1.0), good_steps=jnp.int32(0),
+                              hysteresis=jnp.int32(0))
+    scale = cfg.loss_scale if cfg.loss_scale > 0 else float(2 ** cfg.initial_scale_power)
+    return LossScaleState(scale=jnp.float32(scale), good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(cfg.hysteresis))
+
+
+def grads_finite(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]))
+
+
+def update_loss_scale(state: LossScaleState, finite: jax.Array,
+                      cfg: Float16Config) -> LossScaleState:
+    """One state-machine transition (reference ``loss_scaler.py`` update_scale)."""
+    if not cfg.enabled or cfg.loss_scale > 0:  # static scale
+        return state
+
+    def on_good(s: LossScaleState) -> LossScaleState:
+        grew = s.good_steps + 1 >= cfg.loss_scale_window
+        new_scale = jnp.where(grew, s.scale * 2.0, s.scale)
+        return LossScaleState(
+            scale=new_scale,
+            good_steps=jnp.where(grew, 0, s.good_steps + 1).astype(jnp.int32),
+            hysteresis=jnp.int32(cfg.hysteresis))
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hysteresis = jnp.maximum(s.hysteresis - 1, 0)
+        shrink = hysteresis == 0
+        new_scale = jnp.where(shrink, jnp.maximum(s.scale * 0.5, cfg.min_loss_scale), s.scale)
+        return LossScaleState(scale=new_scale, good_steps=jnp.int32(0),
+                              hysteresis=jnp.where(shrink, cfg.hysteresis, hysteresis).astype(jnp.int32))
+
+    return jax.lax.cond(finite, on_good, on_overflow, state)

@@ -1,0 +1,9 @@
+from .logging import logger, log_dist, print_json_dist, warning_once
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Device-memory report (reference ``runtime/utils.py`` ``see_memory_usage``)."""
+    if not force:
+        return
+    logger.info(f"{message} | {SynchronizedWallClockTimer.memory_usage()}")

@@ -1,0 +1,76 @@
+"""Rank-filtered logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py``
+(``logger`` at :16, ``log_dist`` at :49): a module-level logger plus
+``log_dist(message, ranks=[...])`` that only emits on the listed *process*
+indices.  On a TPU pod there is one process per host, so "rank" here is
+``jax.process_index()``.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int | None = None) -> logging.Logger:
+    if level is None:
+        level = getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO)
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time (tests set platform env first).
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: list[int] | None = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (``None``/``[-1]`` = all).
+
+    Mirrors reference ``utils/logging.py:49``.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_json_dist(message: dict, ranks: list[int] | None = None, path: str | None = None) -> None:
+    """Write a JSON artifact on the given ranks (reference ``utils/logging.py:72``)."""
+    import json
+
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        message = dict(message, rank=my_rank)
+        if path is None:
+            print(json.dumps(message), flush=True)
+        else:
+            with open(path, "w") as fh:
+                json.dump(message, fh)
+
+
+def warning_once(message: str) -> None:
+    _warn_once(message)
+
+
+@functools.lru_cache(None)
+def _warn_once(message: str) -> None:
+    logger.warning(message)

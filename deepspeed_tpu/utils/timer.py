@@ -1,0 +1,167 @@
+"""Wall-clock + throughput timers, async-dispatch aware.
+
+TPU-native analog of the reference's ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` (:24) used CUDA events to avoid host/device
+skew; on TPU the equivalent discipline is ``jax.block_until_ready`` on a
+sentinel array before reading the host clock, because jitted computations
+dispatch asynchronously.  ``ThroughputTimer`` (:135) reports samples/sec
+every ``steps_per_print`` steps.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from .logging import logger
+
+
+def _sync(x: Any = None) -> None:
+    """Drain the async dispatch queue so host timestamps bracket device work.
+
+    Fetches ONE scalar element to the host rather than ``block_until_ready``:
+    device queues are FIFO, so a tiny transfer of the newest result is a
+    reliable fence even on remote/tunneled backends where
+    ``block_until_ready`` can return early, and it never pays a full-array
+    transfer.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if x is not None:
+            leaves = [l for l in jax.tree_util.tree_leaves(x)
+                      if hasattr(l, "ravel")]
+            if leaves:
+                jax.device_get(leaves[0].ravel()[:1])
+                return
+        jax.device_get(jnp.zeros(()) + 0.0)
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+        self.count = 0
+
+    def start(self, sync: bool = False) -> None:
+        if self.started_:
+            return
+        if sync:
+            _sync()
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, sync: bool = True, result: Any = None) -> None:
+        if not self.started_:
+            return
+        if sync:
+            _sync(result)
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.count += 1
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self.elapsed_
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self.elapsed_ / max(self.count, 1)
+
+    def reset(self) -> None:
+        self.elapsed_ = 0.0
+        self.count = 0
+        self.started_ = False
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry (reference ``utils/timer.py:24``)."""
+
+    def __init__(self):
+        self.timers: dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / 2**30
+            peak = stats.get("peak_bytes_in_use", 0) / 2**30
+            return f"mem in-use {in_use:.2f}GB | peak {peak:.2f}GB"
+        except Exception:
+            return "mem stats unavailable"
+
+    def log(self, names: list[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False) -> None:
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        msg = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            msg += " | " + self.memory_usage()
+        logger.info(msg)
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec reporting (reference ``utils/timer.py:135``)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def start(self) -> None:
+        self.started = True
+        self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, result: Any = None) -> None:
+        if not self.started:
+            return
+        self.started = False
+        # Only fence at report boundaries: a per-step host sync would defeat
+        # async dispatch; summed wall-time between fences is still exact.
+        if (self.global_step_count + 1) % self.steps_per_output == 0:
+            _sync(result)
+        duration = time.perf_counter() - self.start_time
+        if global_step:
+            self.global_step_count += 1
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                steps = self.steps_per_output
+                logger.info(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.batch_size * steps / max(self.step_elapsed_time, 1e-9):.2f}, "
+                    f"ms/step={1000.0 * self.step_elapsed_time / steps:.2f}"
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        effective_steps = self.global_step_count - self.start_step
+        if effective_steps <= 0 or self.total_elapsed_time == 0:
+            return 0.0
+        return self.batch_size * effective_steps / self.total_elapsed_time

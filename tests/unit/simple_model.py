@@ -1,0 +1,46 @@
+"""Tiny model/data fixtures — analog of reference ``tests/unit/simple_model.py``
+(``SimpleModel`` :12, ``random_dataloader``, ``args_from_dict``)."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """Linear stack with MSE loss; returns scalar loss like the reference's
+    SimpleModel returns CrossEntropy(x, y)."""
+
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y, deterministic: bool = True):
+        h = x
+        for i in range(self.nlayers):
+            h = nn.Dense(self.hidden_dim, name=f"linear_{i}")(h)
+            h = nn.relu(h)
+        out = nn.Dense(y.shape[-1], name="head")(h)
+        return {"loss": jnp.mean((out - y) ** 2), "logits": out}
+
+    def dummy_inputs(self, batch_size=2, seq_len=None):
+        return {"x": jnp.zeros((batch_size, self.hidden_dim)),
+                "y": jnp.zeros((batch_size, self.hidden_dim))}
+
+
+def random_dataset(total_samples: int, hidden_dim: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    ys = (xs @ rng.normal(size=(hidden_dim, hidden_dim)).astype(np.float32)) * 0.1
+    return [{"x": xs[i], "y": ys[i]} for i in range(total_samples)]
+
+
+def random_token_dataset(total_samples: int, seq_len: int, vocab: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(total_samples, seq_len)).astype(np.int32)
+    return [{"input_ids": ids[i], "labels": ids[i]} for i in range(total_samples)]
+
+
+def token_batch(batch_size: int, seq_len: int, vocab: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(batch_size, seq_len)).astype(np.int32)
+    return {"input_ids": ids, "labels": ids}

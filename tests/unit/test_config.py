@@ -1,0 +1,110 @@
+"""Config parsing + batch arithmetic — parity with reference ``tests/unit/test_config.py``."""
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import Config, ConfigError
+
+
+def test_batch_triple_all_given_consistent():
+    cfg = Config.from_dict({
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    })
+    cfg.resolve_batch(n_devices=8)  # dp_world = 8
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_triple_inconsistent_raises():
+    cfg = Config.from_dict({
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 4,
+    })
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch(n_devices=8)
+
+
+@pytest.mark.parametrize(
+    "given,expected",
+    [
+        ({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, (64, 4, 2)),
+        ({"train_batch_size": 64, "gradient_accumulation_steps": 4}, (64, 2, 4)),
+        ({"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2}, (64, 4, 2)),
+        ({"train_batch_size": 64}, (64, 8, 1)),
+        ({"train_micro_batch_size_per_gpu": 2}, (16, 2, 1)),
+    ],
+)
+def test_batch_triple_derivation(given, expected):
+    cfg = Config.from_dict(given)
+    cfg.resolve_batch(n_devices=8)
+    assert (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+            cfg.gradient_accumulation_steps) == expected
+
+
+def test_batch_respects_mesh_model_axes():
+    # tp=2,pp=2 → dp_world=2 on 8 devices
+    cfg = Config.from_dict({
+        "train_micro_batch_size_per_gpu": 4,
+        "mesh": {"tp": 2, "pp": 2, "dp": -1},
+    })
+    cfg.resolve_batch(n_devices=8)
+    assert cfg.train_batch_size == 8
+
+
+def test_missing_batch_raises():
+    cfg = Config.from_dict({"gradient_accumulation_steps": 2})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch(n_devices=8)
+
+
+def test_optimizer_scheduler_parse():
+    cfg = Config.from_dict({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95],
+                                                  "eps": 1e-8, "weight_decay": 0.1}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+    })
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.optimizer.lr == 3e-4
+    assert cfg.optimizer.betas == (0.9, 0.95)
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_precision_flags():
+    import jax.numpy as jnp
+
+    assert Config.from_dict({}).dtype == jnp.bfloat16  # TPU default
+    cfg = Config.from_dict({"fp16": {"enabled": True}})
+    assert cfg.dtype == jnp.float16
+    assert cfg.fp16.initial_scale_power == 16
+    cfg = Config.from_dict({"bf16": {"enabled": False}})
+    assert cfg.dtype == jnp.float32
+    with pytest.raises(ConfigError):
+        Config.from_dict({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_config():
+    cfg = Config.from_dict({
+        "zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}},
+    })
+    assert cfg.zero.stage == 3
+    assert cfg.zero.offload_optimizer.device == "cpu"
+    with pytest.raises(ConfigError):
+        Config.from_dict({"zero_optimization": {"stage": 5}})
+
+
+def test_unknown_key_raises():
+    with pytest.raises(ConfigError):
+        Config.from_dict({"train_batch_size": 8, "definitely_not_a_key": 1})
+
+
+def test_config_from_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({"train_batch_size": 32, "gradient_clipping": 1.0}))
+    cfg = Config.load(str(path))
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_clipping == 1.0
+    assert Config.load(cfg) is cfg
+    assert Config.load(None).train_batch_size == 0

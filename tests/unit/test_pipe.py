@@ -1,0 +1,177 @@
+"""Pipeline tests — analogs of reference ``test_pipe_schedule.py`` (pure
+schedule math) and ``test_pipe.py`` (pipelined training equals sequential)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.comm.mesh import build_mesh
+from deepspeed_tpu.parallel.pipeline import gpipe_loss
+from deepspeed_tpu.parallel.schedule import (
+    GPipeSchedule, InferenceSchedule, TrainSchedule,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+# ---------------- schedule math (no devices) ----------------
+
+def _flat(sched):
+    return [[repr(i) for i in step] for step in sched]
+
+
+def test_gpipe_schedule_counts():
+    M, S = 4, 2
+    for sid in range(S):
+        steps = _flat(GPipeSchedule(M, S, sid))
+        fwd = sum("ForwardPass" in c for step in steps for c in step)
+        bwd = sum("BackwardPass" in c for step in steps for c in step)
+        assert fwd == M and bwd == M
+        assert any("OptimizerStep" in c for step in steps for c in step)
+
+
+def test_train_schedule_1f1b_counts():
+    M, S = 8, 4
+    for sid in range(S):
+        steps = _flat(TrainSchedule(M, S, sid))
+        fwd = sum("ForwardPass" in c for step in steps for c in step)
+        bwd = sum("BackwardPass" in c for step in steps for c in step)
+        assert fwd == M and bwd == M
+    # first stage loads every microbatch exactly once
+    steps0 = _flat(TrainSchedule(M, S, 0))
+    loads = [c for step in steps0 for c in step if "LoadMicroBatch" in c]
+    assert len(loads) == M
+
+
+def test_train_schedule_warmup_depth():
+    # stage 0 of 4 should run S-1=3 forwards before its first backward
+    steps = _flat(TrainSchedule(8, 4, 0))
+    seen_fwd = 0
+    for step in steps:
+        for c in step:
+            if "ForwardPass" in c:
+                seen_fwd += 1
+            if "BackwardPass" in c:
+                assert seen_fwd >= 4  # 3 warmup + the 1F of this tick
+                return
+
+
+def test_inference_schedule():
+    steps = _flat(InferenceSchedule(4, 2, 1))
+    fwd = sum("ForwardPass" in c for step in steps for c in step)
+    assert fwd == 4
+    assert not any("Backward" in c for step in steps for c in step)
+
+
+def test_schedule_validates_stage():
+    with pytest.raises(ValueError):
+        GPipeSchedule(4, 2, 5)
+
+
+# ---------------- compiled systolic loop ----------------
+
+def _toy_fns(n_layers_total, n_stages, d):
+    """Per-stage MLP stack; reference = sequential apply of all layers."""
+
+    def embed_fn(shared, mb):
+        return mb["x"] @ shared["w_in"]
+
+    def stage_fn(stage_w, h):
+        # stage_w: (L/S, d, d) local layers
+        def layer(h, w):
+            return jnp.tanh(h @ w), None
+
+        h, _ = jax.lax.scan(layer, h, stage_w)
+        return h
+
+    def loss_fn(shared, h, mb):
+        out = h @ shared["w_out"]
+        return jnp.mean((out - mb["y"]) ** 2)
+
+    return embed_fn, stage_fn, loss_fn
+
+
+def _setup(S=4, L=4, d=8, M=4, B=2, seed=0):
+    rng = np.random.default_rng(seed)
+    shared = {"w_in": jnp.asarray(rng.normal(size=(d, d)) * 0.3, jnp.float32),
+              "w_out": jnp.asarray(rng.normal(size=(d, d)) * 0.3, jnp.float32)}
+    layers = jnp.asarray(rng.normal(size=(L, d, d)) * 0.3, jnp.float32)
+    mbs = {"x": jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32),
+           "y": jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)}
+    return shared, layers, mbs
+
+
+def _sequential_loss(shared, layers, mbs, fns):
+    embed_fn, _, loss_fn = fns
+
+    def one(mb):
+        h = embed_fn(shared, mb)
+        for i in range(layers.shape[0]):
+            h = jnp.tanh(h @ layers[i])
+        return loss_fn(shared, h, mb)
+
+    losses = [one(jax.tree_util.tree_map(lambda x: x[i], mbs))
+              for i in range(mbs["x"].shape[0])]
+    return jnp.mean(jnp.stack(losses))
+
+
+def test_gpipe_loss_matches_sequential():
+    S, L, M = 4, 4, 4
+    fns = _toy_fns(L, S, 8)
+    shared, layers, mbs = _setup(S=S, L=L, M=M)
+    mesh = build_mesh({"pp": S, "dp": 2})
+
+    fn = shard_map(
+        lambda sh, st, mb: gpipe_loss(sh, st, mb, embed_fn=fns[0],
+                                      stage_fn=fns[1], loss_fn=fns[2]),
+        mesh=mesh, in_specs=(P(), P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    loss = jax.jit(fn)(shared, layers, mbs)
+    ref = _sequential_loss(shared, layers, mbs, fns)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
+def test_gpipe_grads_match_sequential():
+    S, L, M = 2, 4, 4
+    fns = _toy_fns(L, S, 8)
+    shared, layers, mbs = _setup(S=S, L=L, M=M, seed=3)
+    mesh = build_mesh({"pp": S, "dp": 4})
+
+    pipe = shard_map(
+        lambda sh, st, mb: gpipe_loss(sh, st, mb, embed_fn=fns[0],
+                                      stage_fn=fns[1], loss_fn=fns[2]),
+        mesh=mesh, in_specs=(P(), P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    g_pipe = jax.jit(jax.grad(lambda sh, st: pipe(sh, st, mbs),
+                              argnums=(0, 1)))(shared, layers)
+    g_ref = jax.grad(lambda sh, st: _sequential_loss(sh, st, mbs, fns),
+                     argnums=(0, 1))(shared, layers)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_gpipe_uneven_microbatches():
+    # M > S and M not multiple of S
+    S, L, M = 2, 2, 5
+    fns = _toy_fns(L, S, 8)
+    shared, layers, mbs = _setup(S=S, L=L, M=M, seed=5)
+    mesh = build_mesh({"pp": S, "dp": 4})
+    pipe = shard_map(
+        lambda sh, st, mb: gpipe_loss(sh, st, mb, embed_fn=fns[0],
+                                      stage_fn=fns[1], loss_fn=fns[2]),
+        mesh=mesh, in_specs=(P(), P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    loss = jax.jit(pipe)(shared, layers, mbs)
+    ref = _sequential_loss(shared, layers, mbs, fns)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)

@@ -1,0 +1,66 @@
+"""Rank-grid math tests — parity with reference ``tests/unit/test_topology.py``."""
+import pytest
+
+from deepspeed_tpu.comm.topology import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_list(axis="row", idx=0) == [0, 1]
+    assert topo.get_axis_list(axis="col", idx=1) == [1, 3]
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_rank_roundtrip():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    for rank in range(topo.world_size()):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord._asdict()) == rank
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # ranks: (p0,d0)=0 (p0,d1)=1 (p1,d0)=2 (p1,d1)=3
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert sorted(pipe_lists) == [[0, 2], [1, 3]]
+    data_lists = topo.get_axis_comm_lists("data")
+    assert sorted(data_lists) == [[0, 1], [2, 3]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0, model=1)
+    assert all(getattr(topo.get_coord(r), "pipe") == 0 for r in ranks)
+    assert all(getattr(topo.get_coord(r), "model") == 1 for r in ranks)
+    assert len(ranks) == 2
+
+
+def test_topology_axis_order_matches_reference():
+    # reference topology.py:246: axes ['pipe','data','model'], model fastest
+    topo = PipeModelDataParallelTopology(num_pp=1, num_mp=2, num_dp=2)
+    assert topo.get_rank(pipe=0, data=0, model=0) == 0
+    assert topo.get_rank(pipe=0, data=0, model=1) == 1
+    assert topo.get_rank(pipe=0, data=1, model=0) == 2
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.get_rank_repr(rank=0) == "pipe_00-model_00"
+    assert "data" not in topo.get_rank_repr(rank=0)
