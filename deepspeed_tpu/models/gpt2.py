@@ -245,6 +245,60 @@ class GPT2LMHeadModel(nn.Module):
             out["loss"] = cross_entropy_loss(logits, tgt)
         return out
 
+    # -- pipeline decomposition (parallel/pipeline.py contract) --------
+    @nn.nowrap
+    def pipeline_fns(self, n_stages: int):
+        """Split the forward pass into (embed, stage, loss) closures.
+
+        The stage function re-binds the same scanned ``Block`` stack over a
+        ``n_layer/n_stages``-slice of the ``h`` params, so PP reuses the
+        exact single-path math (no drift between PP and non-PP).
+        """
+        cfg = self.cfg
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires scan_layers=True")
+        if cfg.n_layer % n_stages != 0:
+            raise ValueError(f"n_layer {cfg.n_layer} not divisible by pp={n_stages}")
+        local_layers = cfg.n_layer // n_stages
+
+        stage_stack = nn.scan(
+            Block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=local_layers,
+            in_axes=nn.broadcast,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"},
+        )(cfg, True)
+        ln_f = LayerNorm(cfg)
+
+        def split_params(params):
+            shared = {k: v for k, v in params.items() if k != "h"}
+            return shared, params["h"]
+
+        def merge_params(shared, stage):
+            return {**shared, "h": stage}
+
+        def embed_fn(shared, mb):
+            ids = mb["input_ids"]
+            S = ids.shape[1]
+            pos = jnp.arange(S)[None, :]
+            return (shared["wte"].astype(cfg.dtype)[ids]
+                    + shared["wpe"].astype(cfg.dtype)[pos])
+
+        def stage_fn(stage_params, h):
+            h, _ = stage_stack.apply({"params": stage_params}, h, None)
+            return h
+
+        def loss_fn(shared, h, mb):
+            h = ln_f.apply({"params": shared["ln_f"]}, h)
+            logits = jnp.dot(h, shared["wte"].astype(cfg.dtype).T)
+            if cfg.padded_vocab_size != cfg.vocab_size:
+                pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+                logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+            return cross_entropy_loss(logits, shift_labels(mb["labels"]))
+
+        return embed_fn, stage_fn, loss_fn, split_params, merge_params
+
     # -- engine integration hooks ------------------------------------
     def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
         S = seq_len or min(self.cfg.n_positions, 128)

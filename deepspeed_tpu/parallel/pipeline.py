@@ -102,8 +102,6 @@ def pipeline_spmd_loss(mesh, shared_params, stage_params, microbatches, *,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    other = frozenset(n for n in mesh.axis_names if n != axis)
-
     fn = functools.partial(gpipe_loss, embed_fn=embed_fn, stage_fn=stage_fn,
                            loss_fn=loss_fn, axis=axis)
     return shard_map(
@@ -111,5 +109,5 @@ def pipeline_spmd_loss(mesh, shared_params, stage_params, microbatches, *,
         in_specs=(P(), stage_params_layer_dim_spec, P()),
         out_specs=P(),
         check_vma=False,
-        auto=other,
+        axis_names={axis},  # manual ONLY over pp; dp/fsdp/tp stay automatic
     )(shared_params, stage_params, microbatches)

@@ -154,6 +154,10 @@ class Engine:
         return self.config.fp16.enabled
 
     @property
+    def pp_size(self) -> int:
+        return self.mesh.shape["pp"]
+
+    @property
     def bfloat16_enabled(self) -> bool:
         return self.config.bf16.enabled
 
@@ -244,6 +248,9 @@ class Engine:
             boxed = jax.eval_shape(_init, rng)["params"]
 
         stage = self.zero_stage
+        if self.pp_size > 1:
+            # pipeline stages own their slice of the stacked layer dim
+            self._partition_rules = dict(self._partition_rules, layers="pp")
         self._param_specs = zero_lib.param_partition_specs(
             boxed, self.mesh, stage, rules=self._partition_rules)
         stage3_like = zero_lib.shard_like_stage3(boxed, self.mesh,
@@ -359,6 +366,8 @@ class Engine:
 
     @functools.cached_property
     def _compiled_train_step(self):
+        if self.pp_size > 1:
+            return self._compiled_pipeline_step
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
 
@@ -386,6 +395,37 @@ class Engine:
                     state.params, batch, rng, scale)
                 g_sum = self._constrain(g_sum, self._grad_specs)
             return self._apply_grads(state, g_sum, loss_sum, jnp.float32(gas))
+
+        return jax.jit(step_fn, donate_argnums=(0,),
+                       out_shardings=(self._state_shardings, None))
+
+    @functools.cached_property
+    def _compiled_pipeline_step(self):
+        """Train step when mesh pp>1: grad-accumulation micro-batches ARE
+        the pipeline micro-batches; the whole GPipe wave is one scan (see
+        ``parallel/pipeline.py``)."""
+        from ..parallel.pipeline import pipeline_spmd_loss
+
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        embed_fn, stage_fn, loss_fn, split_params, _ = \
+            self.model.pipeline_fns(self.pp_size)
+
+        def step_fn(state: TrainState, batch):
+            scale = state.loss_scale.scale if cfg.fp16.enabled else jnp.float32(1.0)
+            mbs = self._split_microbatches(batch, gas)
+
+            def scaled_loss(params):
+                shared, stage_params = split_params(params)
+                loss = pipeline_spmd_loss(
+                    self.mesh, shared, stage_params, mbs,
+                    embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                    stage_params_layer_dim_spec=P("pp"))
+                return loss * scale
+
+            loss, grads = jax.value_and_grad(scaled_loss)(state.params)
+            grads = self._constrain(grads, self._grad_specs)
+            return self._apply_grads(state, grads, loss, jnp.float32(1.0))
 
         return jax.jit(step_fn, donate_argnums=(0,),
                        out_shardings=(self._state_shardings, None))

@@ -1,0 +1,86 @@
+"""End-to-end pipeline-parallel GPT-2 through the engine — PP result must
+match the non-PP engine on identical data/init (analog of reference
+``test_pipe.py``'s train-parity assertions)."""
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+from .simple_model import token_batch
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _make(mesh_cfg, gas=4):
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "mesh": mesh_cfg,
+    })
+    engine.init_params()
+    return engine
+
+
+def test_pp_engine_trains():
+    e_pp = _make({"pp": 2, "dp": 4})
+    batch = token_batch(e_pp.train_batch_size, 32, 512, seed=0)
+    losses = [float(e_pp.train_batch(batch)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # memorizes the repeated batch
+
+
+def test_pp_loss_matches_non_pp_exactly():
+    """Same dp_world on both sides → identical batches → identical losses.
+    SGD so tiny bf16 grad noise can't sign-flip the update (Adam would)."""
+    gas = 4
+    opt = {"type": "sgd", "params": {"lr": 0.05}}
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    e_pp, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": gas,
+        "optimizer": opt, "mesh": {"pp": 2, "dp": 4}})
+    e_pp.init_params()
+    batch = token_batch(e_pp.train_batch_size, 32, 512, seed=1)
+    l_pp = [float(e_pp.train_batch(batch)) for _ in range(2)]
+
+    mesh_mod.set_mesh(None)
+    from deepspeed_tpu.comm.mesh import build_mesh
+
+    mesh4 = build_mesh({"dp": 4}, devices=jax.devices()[:4])  # no pp
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    e_ref, _, _, _ = deepspeed_tpu.initialize(model=model, mesh=mesh4, config={
+        "train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": gas,
+        "optimizer": opt})
+    e_ref.init_params()
+    assert e_ref.train_batch_size == e_pp.train_batch_size
+    l_ref = [float(e_ref.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(l_pp, l_ref, rtol=2e-3)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(e_pp.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(e_ref.params))):
+        # bf16 compute in a different (pipelined) layout rounds differently;
+        # loss parity above is the tight check
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-4)
+
+
+def test_pp_with_zero3():
+    e = _make({"pp": 2, "fsdp": 4})
+    # stage-3 fsdp sharding composes with pp-sharded layer stacks
+    batch = token_batch(e.train_batch_size, 32, 512, seed=2)
+    losses = [float(e.train_batch(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all()
+
+
+def test_pp_requires_divisible_layers():
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny"))  # 2 layers
+    with pytest.raises(ValueError):
+        model.pipeline_fns(3)
