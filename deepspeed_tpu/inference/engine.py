@@ -1,0 +1,234 @@
+"""Inference engine: TP-sharded serving with compiled prefill/decode.
+
+Analog of reference ``deepspeed.init_inference`` → ``InferenceEngine``
+(``inference/engine.py:25``): there, injection policies rewrite torch
+modules into fused CUDA kernels with a KV cache, CUDA graphs capture the
+decode step (``engine.py:363,382``), and tensor slicing splits weights
+across mp ranks (``module_inject/replace_module.py:41``).
+
+TPU-native equivalences:
+
+- CUDA-graph capture/replay ≡ a jitted decode step (XLA compiles once,
+  replays forever — "free" graphs).
+- kernel injection ≡ the model zoo already runs fused XLA/Pallas paths;
+  for HF users, :mod:`..module_inject` converts HF checkpoints into zoo
+  params (the policy-class analog).
+- tensor slicing ≡ TP PartitionSpecs on a ``tp`` mesh axis; the per-layer
+  partial-output allreduce the reference issues by hand
+  (``transformer_inference.py`` mp allreduce) is inserted by XLA.
+- KV cache ≡ a flax ``cache`` collection with static max length, updated
+  by ``dynamic_update_slice`` inside the compiled step.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm
+from ..comm.mesh import MeshConfig, build_mesh, set_mesh
+from ..models.common import TP_RULES
+from ..parallel import zero as zero_lib
+from ..utils import log_dist
+
+
+@dataclasses.dataclass
+class InferenceConfig:
+    """Subset-compatible with ``init_inference`` kwargs (reference
+    ``deepspeed/__init__.py:222``)."""
+
+    mp_size: int = 1
+    dtype: Any = None                  # default bf16
+    max_tokens: Optional[int] = None   # cache length; default model n_positions
+    replace_with_kernel_inject: bool = True   # accepted; zoo is always "injected"
+    checkpoint: Optional[str] = None
+    quant: dict = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def load(d) -> "InferenceConfig":
+        if isinstance(d, InferenceConfig):
+            return d
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(InferenceConfig)}
+        extra = {k: v for k, v in d.items() if k not in known}
+        cfg = InferenceConfig(**{k: v for k, v in d.items() if k in known})
+        if extra:
+            from ..utils.logging import logger
+
+            logger.warning(f"init_inference: ignoring unsupported keys {sorted(extra)}")
+        return cfg
+
+
+class InferenceEngine:
+    """Serving wrapper: ``engine(input_ids)`` forward + ``generate()``.
+
+    ``model``: a zoo module (e.g. ``GPT2LMHeadModel``) — its config is
+    cloned into decode mode for the cached step.  ``params``: optional
+    ready param tree; otherwise pass ``checkpoint`` (a training checkpoint
+    dir) or call ``load_params``.
+    """
+
+    def __init__(self, model=None, config=None, params=None, mesh=None, **kwargs):
+        merged = dict(config or {})
+        merged.update(kwargs)
+        self.config = InferenceConfig.load(merged)
+        self.model = model
+        cfg = model.cfg
+        if self.config.dtype is not None:
+            cfg = dataclasses.replace(cfg, dtype=self.config.dtype)
+        self.model_cfg = dataclasses.replace(cfg, remat=False)
+        self.decode_cfg = dataclasses.replace(
+            self.model_cfg, decode=True,
+            n_positions=self.config.max_tokens or cfg.n_positions)
+        self._fwd_model = type(model)(self.model_cfg)
+        self._decode_model = type(model)(self.decode_cfg)
+
+        if mesh is None:
+            mesh = comm.get_mesh(required=False)
+        if mesh is None:
+            mesh = build_mesh({"tp": self.config.mp_size, "dp": -1})
+            set_mesh(mesh)
+        self.mesh = mesh
+
+        self.params = None
+        if params is not None:
+            self.load_params(params)
+        elif self.config.checkpoint:
+            self.load_checkpoint(self.config.checkpoint)
+
+    # ------------------------------------------------------------------
+    def _param_shardings(self, abstract_boxed):
+        specs = zero_lib.param_partition_specs(abstract_boxed, self.mesh,
+                                               zero_stage=0, rules=TP_RULES)
+        return zero_lib.named_shardings(self.mesh, specs)
+
+    def load_params(self, params):
+        """Place a host/abstract param tree with TP shardings (the tensor-
+        slicing analog of ``ReplaceWithTensorSlicing``)."""
+        dummy = self.model.dummy_inputs(1)
+        boxed = jax.eval_shape(
+            lambda r: self._fwd_model.init(r, dummy["input_ids"]),
+            jax.random.PRNGKey(0))["params"]
+        shardings = self._param_shardings(boxed)
+        unboxed = jax.tree_util.tree_map(
+            lambda x: getattr(x, "value", x), params,
+            is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        self.params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), unboxed, shardings)
+        n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+        log_dist(f"inference params loaded: {n/1e6:.1f}M, mp={self.mesh.shape['tp']}",
+                 ranks=[0])
+        return self
+
+    def load_checkpoint(self, ckpt_dir: str, tag: Optional[str] = None):
+        """Load params from a TRAINING checkpoint dir (SDLoader analog —
+        resharding to the serving mesh happens on restore)."""
+        from ..runtime.checkpointing import get_fp32_state_dict_from_checkpoint
+
+        params = get_fp32_state_dict_from_checkpoint(ckpt_dir, tag)
+        return self.load_params(params)
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _compiled_forward(self):
+        def fwd(params, input_ids):
+            return self._fwd_model.apply({"params": params}, input_ids)["logits"]
+
+        return jax.jit(fwd)
+
+    def forward(self, input_ids, **kwargs):
+        if self.params is None:
+            raise RuntimeError("no parameters loaded; pass params=/checkpoint=")
+        return self._compiled_forward(self.params, jnp.asarray(input_ids))
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _compiled_prefill(self):
+        def prefill(params, cache, input_ids, position_ids):
+            out, vars_ = self._decode_model.apply(
+                {"params": params, "cache": cache}, input_ids,
+                position_ids=position_ids, mutable=["cache"])
+            return out["logits"], vars_["cache"]
+
+        return jax.jit(prefill)
+
+    @functools.lru_cache(maxsize=8)
+    def _compiled_decode_step(self, top_k: int):
+        """One fused decode tick: cache-append forward + sampling.  Compiled
+        once per top_k (static); the CUDA-graph-replay analog."""
+
+        def step(params, cache, token, position, rng, temperature):
+            out, vars_ = self._decode_model.apply(
+                {"params": params, "cache": cache}, token,
+                position_ids=position, mutable=["cache"])
+            next_logits = out["logits"][:, -1, :].astype(jnp.float32)
+            next_token = _sample(next_logits, rng, temperature, top_k)
+            return next_token, vars_["cache"]
+
+        return jax.jit(step)
+
+    def init_cache(self, batch_size: int):
+        dummy = jnp.zeros((batch_size, 1), jnp.int32)
+        vars_ = jax.eval_shape(
+            lambda r: self._decode_model.init(r, dummy,
+                                              position_ids=jnp.zeros((1, 1), jnp.int32)),
+            jax.random.PRNGKey(0))
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), vars_["cache"])
+        return cache
+
+    def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0, eos_token_id: Optional[int] = None):
+        """Autoregressive generation: compiled prefill + compiled decode step.
+
+        Greedy when ``temperature == 0``.  Returns (B, S+max_new_tokens).
+        """
+        if self.params is None:
+            raise RuntimeError("no parameters loaded; pass params=/checkpoint=")
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, S = input_ids.shape
+        limit = self.decode_cfg.n_positions
+        if S + max_new_tokens > limit:
+            raise ValueError(f"prompt({S}) + max_new_tokens({max_new_tokens}) "
+                             f"exceeds cache length {limit}")
+        cache = self.init_cache(B)
+        positions = jnp.arange(S)[None, :].repeat(B, 0)
+        logits, cache = self._compiled_prefill(self.params, cache, input_ids, positions)
+        rng = jax.random.PRNGKey(seed)
+        temp = jnp.float32(temperature)
+        decode_step = self._compiled_decode_step(int(top_k))
+
+        rng, sub = jax.random.split(rng)
+        token = _sample(logits[:, -1, :].astype(jnp.float32), sub, temp, int(top_k))
+        tokens = [token]
+        pos = S
+        for _ in range(max_new_tokens - 1):
+            rng, sub = jax.random.split(rng)
+            token, cache = decode_step(
+                self.params, cache, token[:, None],
+                jnp.full((B, 1), pos, jnp.int32), sub, temp)
+            tokens.append(token)
+            pos += 1
+            if eos_token_id is not None and bool(
+                    jax.device_get((token == eos_token_id).all())):
+                break
+        return jnp.concatenate([input_ids] + [t[:, None] for t in tokens], axis=1)
+
+
+def _sample(logits, rng, temperature, top_k: int):
+    """Greedy / temperature / top-k sampling on fp32 logits (B, V);
+    ``top_k`` is static."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -45,6 +45,7 @@ class GPT2Config:
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"            # auto | jnp | flash | ring
     vocab_pad_multiple: int = 128      # MXU/TP-friendly vocab padding
+    decode: bool = False               # KV-cache autoregressive mode
 
     @property
     def padded_vocab_size(self) -> int:
@@ -124,6 +125,36 @@ class SelfAttention(nn.Module):
         q = q.reshape(B, S, H, D)
         k = k.reshape(B, S, H, D)
         v = v.reshape(B, S, H, D)
+        if cfg.decode:
+            # KV-cache: the analog of the inference kernel's context cache
+            # (reference csrc/transformer/inference/csrc/softmax.cu keeps
+            # triangular-masked history; here it's a mutable 'cache'
+            # collection updated in place, static max length)
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, cfg.n_positions, H, D), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, cfg.n_positions, H, D), cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+            idx.value = cur + S
+            k_full, v_full = ck.value, cv.value
+            # position t may attend cache slots <= cur + t
+            q_pos = cur + jnp.arange(S)[:, None]
+            k_pos = jnp.arange(cfg.n_positions)[None, :]
+            mask = (k_pos <= q_pos)[None, None, :, :]
+            if attn_mask is not None:
+                mask = jnp.logical_and(mask, attn_mask)
+            y = dot_product_attention(q, k_full, v_full, causal=False,
+                                      mask=mask, impl="jnp")
+            y = y.reshape(B, S, E)
+            out = _dense(y, E, ("heads", "embed"), cfg=cfg, name="c_proj", module=self,
+                         init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)
+            return out
         dropout_rng = None
         if cfg.attn_pdrop > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
@@ -199,6 +230,9 @@ class GPT2LMHeadModel(nn.Module):
             (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
 
         if position_ids is None:
+            if cfg.decode:
+                raise ValueError("decode mode requires explicit position_ids "
+                                 "(the inference engine tracks them)")
             position_ids = jnp.arange(S)[None, :]
         h = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[position_ids]
         if cfg.embd_pdrop > 0.0 and not deterministic:
@@ -216,7 +250,7 @@ class GPT2LMHeadModel(nn.Module):
                     prevent_cse=False, static_argnums=())
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,

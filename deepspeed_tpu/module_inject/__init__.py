@@ -1,0 +1,1 @@
+from .policies import convert_hf_model, register_policy  # noqa: F401

@@ -1,0 +1,120 @@
+"""HF-model conversion policies — the ``module_inject`` analog.
+
+Reference mechanism (``deepspeed/module_inject/replace_module.py:123``
+``replace_transformer_layer`` + policy classes in ``replace_policy.py``:
+HFBert :50, HFGPTNEO :113, HFGPTJ :158, Megatron :203, HFGPT2 :284,
+GPTNEOX :324): each policy records where q/k/v/o/mlp weights live inside a
+given architecture so layers can be swapped for fused kernels and sliced
+across mp ranks.
+
+TPU-native, the zoo modules ARE the fused path, so "injection" becomes
+checkpoint conversion: a policy maps an HF ``state_dict`` into a zoo param
+tree (+ zoo config), after which the inference engine's TP shardings do the
+tensor slicing.  Policies are pure host-side numpy transforms — no torch
+on the device path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ..utils.logging import logger
+
+_POLICIES: dict[str, Callable] = {}
+
+
+def register_policy(hf_class_name: str):
+    def deco(fn):
+        _POLICIES[hf_class_name] = fn
+        return fn
+
+    return deco
+
+
+def convert_hf_model(hf_model, dtype=None):
+    """HF torch model → ``(zoo_model, params)``.
+
+    Dispatch by class name (the ``replace_module.py`` policy match).
+    """
+    name = type(hf_model).__name__
+    for key, policy in _POLICIES.items():
+        if key in name:
+            return policy(hf_model, dtype=dtype)
+    raise ValueError(
+        f"no conversion policy for HF class {name!r}; registered: "
+        f"{sorted(_POLICIES)} (reference replace_policy.py parity list)")
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+@register_policy("GPT2LMHeadModel")
+def convert_hf_gpt2(hf_model, dtype=None):
+    """HF GPT-2 → zoo ``GPT2LMHeadModel`` (policy analog of
+    ``replace_policy.py:284`` ``HFGPT2LayerPolicy``).
+
+    HF's Conv1D stores kernels as (in, out) — same layout our dense uses,
+    so no transposes; per-layer tensors stack onto the scanned ``layers``
+    dim.
+    """
+    import jax.numpy as jnp
+
+    from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    hc = hf_model.config
+    cfg = GPT2Config(
+        vocab_size=hc.vocab_size,
+        n_positions=hc.n_positions,
+        n_embd=hc.n_embd,
+        n_layer=hc.n_layer,
+        n_head=hc.n_head,
+        layer_norm_epsilon=hc.layer_norm_epsilon,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        scan_layers=True,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = cfg.n_layer
+
+    def stacked(fmt):
+        return np.stack([sd[fmt.format(i)] for i in range(L)])
+
+    wte = sd["transformer.wte.weight"].astype(np.float32)
+    if cfg.padded_vocab_size != cfg.vocab_size:
+        pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size, cfg.n_embd), np.float32)
+        wte = np.concatenate([wte, pad], axis=0)
+
+    params = {
+        "wte": wte,
+        "wpe": sd["transformer.wpe.weight"].astype(np.float32),
+        "ln_f": {"scale": sd["transformer.ln_f.weight"],
+                 "bias": sd["transformer.ln_f.bias"]},
+        "h": {
+            "ln_1": {"scale": stacked("transformer.h.{}.ln_1.weight"),
+                     "bias": stacked("transformer.h.{}.ln_1.bias")},
+            "ln_2": {"scale": stacked("transformer.h.{}.ln_2.weight"),
+                     "bias": stacked("transformer.h.{}.ln_2.bias")},
+            "attn": {
+                "c_attn_kernel": stacked("transformer.h.{}.attn.c_attn.weight"),
+                "c_attn_bias": stacked("transformer.h.{}.attn.c_attn.bias"),
+                "c_proj_kernel": stacked("transformer.h.{}.attn.c_proj.weight"),
+                "c_proj_bias": stacked("transformer.h.{}.attn.c_proj.bias"),
+            },
+            "mlp": {
+                "c_fc_kernel": stacked("transformer.h.{}.mlp.c_fc.weight"),
+                "c_fc_bias": stacked("transformer.h.{}.mlp.c_fc.bias"),
+                "c_proj_kernel": stacked("transformer.h.{}.mlp.c_proj.weight"),
+                "c_proj_bias": stacked("transformer.h.{}.mlp.c_proj.bias"),
+            },
+        },
+    }
+    params = {k: _tree_f32(v) for k, v in params.items()}
+    logger.info(f"converted HF GPT-2 ({cfg.n_layer}L, {cfg.n_embd}d) to zoo params")
+    return GPT2LMHeadModel(cfg), params
+
+
+def _tree_f32(x):
+    if isinstance(x, dict):
+        return {k: _tree_f32(v) for k, v in x.items()}
+    return np.asarray(x, dtype=np.float32)
