@@ -1,0 +1,165 @@
+"""Block-sparse attention execution.
+
+Role of the reference's Triton stack (``ops/sparse_attention/matmul.py``
+SDD/DSD blocksparse matmuls + ``softmax.py`` blocksparse softmax +
+``sparse_self_attention.py`` orchestration): compute attention touching
+only the blocks a :class:`SparsityConfig` layout enables.
+
+Two TPU paths:
+
+- ``impl="mask"`` — expand the block layout to an element mask and run the
+  fused XLA attention.  Same FLOPs as dense but numerically exact; the
+  baseline and the path for CPU tests.
+- ``impl="pallas"`` — a Pallas kernel iterating only the enabled key
+  blocks per query block via a compacted per-row LUT (the Triton-LUT
+  analog, built host-side).  Compute and HBM traffic scale with nnz
+  blocks — this is where the reference's "6.3× faster, 10× longer
+  sequences" headline comes from.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..attention import _jnp_attention
+from .sparsity_config import SparsityConfig
+
+NEG_INF = float("-inf")
+
+
+def layout_to_dense_mask(layout: np.ndarray, block: int) -> np.ndarray:
+    """(H, nb, nb) block layout → (H, S, S) bool element mask."""
+    return np.kron(layout, np.ones((block, block), dtype=bool))
+
+
+def _build_lut(layout: np.ndarray):
+    """Per (head, q-block): padded list of enabled k-block indices + count.
+
+    The Triton-LUT analog; padding repeats the first enabled block (those
+    columns are masked again in-kernel by the exact count).
+    """
+    H, nq, nk = layout.shape
+    max_nnz = int(layout.sum(axis=2).max())
+    lut = np.zeros((H, nq, max_nnz), dtype=np.int32)
+    counts = np.zeros((H, nq), dtype=np.int32)
+    for h in range(H):
+        for qi in range(nq):
+            idx = np.nonzero(layout[h, qi])[0]
+            counts[h, qi] = len(idx)
+            if len(idx):
+                lut[h, qi, :len(idx)] = idx
+                lut[h, qi, len(idx):] = idx[0]
+    return lut, counts, max_nnz
+
+
+def _pallas_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, *,
+                   scale, block, causal):
+    from jax.experimental import pallas as pl
+
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    nnz = lut_ref.shape[-1]
+    q = q_ref[0, 0].astype(jnp.float32) * scale              # (block, D)
+    cnt = cnt_ref[h, qi]
+
+    m0 = jnp.full((block,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block,), jnp.float32)
+    acc0 = jnp.zeros((block, q.shape[-1]), jnp.float32)
+
+    def body(t, carry):
+        m, l, acc = carry
+        j = lut_ref[h, qi, t]
+        k = k_ref[0, 0, pl.ds(j * block, block)].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block, block)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        corr = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_safe))
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, cnt, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     layout: np.ndarray, block: int, *,
+                     causal: bool = False, scale: Optional[float] = None,
+                     impl: str = "mask", interpret: bool = False) -> jax.Array:
+    """Block-sparse attention; shapes ``(B, S, H, D)``; layout ``(H, nb, nb)``."""
+    B, S, H, D = q.shape
+    nb = S // block
+    if layout.shape != (H, nb, nb):
+        raise ValueError(f"layout shape {layout.shape} != {(H, nb, nb)}")
+    if scale is None:
+        scale = D ** -0.5
+
+    if impl == "mask":
+        mask = jnp.asarray(layout_to_dense_mask(layout, block))[None]  # (1,H,S,S)
+        return _jnp_attention(q, k, v, causal=causal, bias=None, mask=mask,
+                              dropout_rate=0.0, dropout_rng=None, scale=scale)
+
+    if impl != "pallas":
+        raise ValueError(f"unknown impl {impl!r}")
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    lut, counts, max_nnz = _build_lut(np.asarray(layout))
+    qt = q.transpose(0, 2, 1, 3)   # (B, H, S, D)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    kernel = functools.partial(_pallas_kernel, scale=scale, block=block,
+                               causal=causal)
+    # LUT + counts ride as scalar-prefetch (SMEM) — the Triton-LUT analog
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block, D), lambda b, h, i, *_: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block, D), lambda b, h, i, *_: (b, h, i, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(lut), jnp.asarray(counts), qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+class SparseSelfAttention:
+    """Module-shaped wrapper (reference ``sparse_self_attention.py``):
+    holds a :class:`SparsityConfig`, lazily builds per-seq-len layouts."""
+
+    def __init__(self, sparsity_config: SparsityConfig, causal: bool = False,
+                 impl: str = "mask"):
+        self.sparsity_config = sparsity_config
+        self.causal = causal
+        self.impl = impl
+        self._layouts: dict[int, np.ndarray] = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v):
+        layout = self.get_layout(q.shape[1])
+        return sparse_attention(q, k, v, layout, self.sparsity_config.block,
+                                causal=self.causal, impl=self.impl)
