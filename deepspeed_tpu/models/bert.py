@@ -1,0 +1,282 @@
+"""BERT model family, TPU-native.
+
+The reference's headline benchmark is BERT-Large pretraining with its fused
+transformer kernel (``docs/_tutorials/bert-pretraining.md:388`` — 64 TFLOPS
+on V100) and optional block-sparse attention
+(``deepspeed/ops/sparse_attention/sparse_attention_utils.py`` patches HF
+BERT).  Here BERT is a first-class zoo model: post-LN encoder, fused QKV
+projection, optional :class:`SparsityConfig`-driven sparse attention, and
+the same logical-axis annotations as GPT-2 so TP/ZeRO sharding rules apply
+unchanged.
+
+Heads: ``BertForPreTraining`` = masked-LM (tied decoder) + next-sentence
+prediction, the classic pretraining objective the reference's tutorial
+runs.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from .common import ModelOutput, cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"
+    vocab_pad_multiple: int = 128
+    sparse_attention: Optional[dict] = None   # SparsityConfig kwargs + "mode"
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "bert-tiny": dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=128,
+                      max_position_embeddings=128),
+    "bert-base": dict(hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+}
+
+
+def bert_config(preset: str = "bert-base", **overrides) -> BertConfig:
+    if preset not in PRESETS:
+        raise ValueError(f"unknown BERT preset {preset!r}; valid: {sorted(PRESETS)}")
+    return BertConfig(**{**PRESETS[preset], **overrides})
+
+
+def _dense(x, features, names, *, cfg, name, module, use_bias=True):
+    kernel = module.param(
+        name + "_kernel",
+        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+        (x.shape[-1], features), cfg.param_dtype)
+    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if use_bias:
+        bias = module.param(name + "_bias",
+                            nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+                            (features,), cfg.param_dtype)
+        y = y + bias.astype(cfg.dtype)
+    return y
+
+
+class BertLayerNorm(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_eps)
+        scale = self.param("scale", nn.with_partitioning(nn.initializers.ones, ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                          (x.shape[-1],), self.cfg.param_dtype)
+        return (y * scale + bias).astype(dtype)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, deterministic: bool):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        qkv = _dense(x, 3 * E, ("embed", "qkv"), cfg=cfg, name="qkv", module=self)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(B, S, H, D) for t in (q, k, v))
+
+        if cfg.sparse_attention:
+            from ..ops.sparse_attention import sparse_self_attention as ssa_mod
+            from ..ops.sparse_attention import sparsity_config as sc_mod
+
+            sa_kwargs = dict(cfg.sparse_attention)
+            mode = sa_kwargs.pop("mode", "fixed")
+            cls = {"dense": sc_mod.DenseSparsityConfig,
+                   "fixed": sc_mod.FixedSparsityConfig,
+                   "variable": sc_mod.VariableSparsityConfig,
+                   "bigbird": sc_mod.BigBirdSparsityConfig,
+                   "bslongformer": sc_mod.BSLongformerSparsityConfig}[mode]
+            sconf = cls(num_heads=H, **sa_kwargs)
+            layout = sconf.make_layout(S)
+            y = ssa_mod.sparse_attention(q, k, v, layout, sconf.block,
+                                         causal=False)
+        else:
+            dropout_rng = None
+            rate = cfg.attention_probs_dropout_prob
+            if rate > 0.0 and not deterministic:
+                dropout_rng = self.make_rng("dropout")
+            y = dot_product_attention(
+                q, k, v, causal=False, mask=attn_mask,
+                dropout_rate=0.0 if deterministic else rate,
+                dropout_rng=dropout_rng, impl=cfg.attn_impl)
+        y = y.reshape(B, S, E)
+        return _dense(y, E, ("heads", "embed"), cfg=cfg, name="output", module=self)
+
+
+class BertLayer(nn.Module):
+    """Post-LN encoder block (original BERT residual order)."""
+
+    cfg: BertConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, attn_mask):
+        cfg = self.cfg
+        att = BertSelfAttention(cfg, name="attention")(x, attn_mask, self.deterministic)
+        if cfg.hidden_dropout_prob > 0.0 and not self.deterministic:
+            att = nn.Dropout(cfg.hidden_dropout_prob)(att, deterministic=False)
+        x = BertLayerNorm(cfg, name="attention_ln")(x + att)
+        h = _dense(x, cfg.intermediate_size, ("embed", "mlp"), cfg=cfg,
+                   name="intermediate", module=self)
+        h = nn.gelu(h, approximate=False)
+        h = _dense(h, cfg.hidden_size, ("mlp", "embed"), cfg=cfg,
+                   name="output", module=self)
+        if cfg.hidden_dropout_prob > 0.0 and not self.deterministic:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        x = BertLayerNorm(cfg, name="output_ln")(x + h)
+        return x, None
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+    add_pooler: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        word = self.param("word_embeddings", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")),
+            (cfg.padded_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param("position_embeddings", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("pos", "embed")),
+            (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        typ = self.param("token_type_embeddings", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), (None, "embed")),
+            (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+
+        if position_ids is None:
+            position_ids = jnp.arange(S)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        h = (word.astype(cfg.dtype)[input_ids]
+             + pos.astype(cfg.dtype)[position_ids]
+             + typ.astype(cfg.dtype)[token_type_ids])
+        h = BertLayerNorm(cfg, name="embeddings_ln")(h)
+        if cfg.hidden_dropout_prob > 0.0 and not deterministic:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer,
+                                 policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                                 prevent_cse=False)
+        if cfg.scan_layers:
+            stack = nn.scan(layer_cls,
+                            variable_axes={"params": 0},
+                            split_rngs={"params": True, "dropout": True},
+                            length=cfg.num_hidden_layers,
+                            in_axes=nn.broadcast,
+                            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, _ = stack(cfg, deterministic, name="encoder")(h, mask)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                h, _ = layer_cls(cfg, deterministic, name=f"encoder_{i}")(h, mask)
+
+        pooled = None
+        if self.add_pooler:
+            pooled = _dense(h[:, 0], cfg.hidden_size, ("embed", "embed_out"),
+                            cfg=cfg, name="pooler", module=self)
+            pooled = jnp.tanh(pooled)
+        return h, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP pretraining head (the BERT-Large baseline objective)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 labels=None, next_sentence_label=None, deterministic: bool = True):
+        cfg = self.cfg
+        bert = BertModel(cfg, name="bert")
+        h, pooled = bert(input_ids, attention_mask, token_type_ids,
+                         deterministic=deterministic)
+        # MLM transform + tied decoder
+        t = _dense(h, cfg.hidden_size, ("embed", "embed_out"), cfg=cfg,
+                   name="transform", module=self)
+        t = nn.gelu(t, approximate=False)
+        t = BertLayerNorm(cfg, name="transform_ln")(t)
+        word = bert.variables["params"]["word_embeddings"]
+        word = word.value if hasattr(word, "value") else word
+        logits = jnp.dot(t, word.astype(cfg.dtype).T)
+        decoder_bias = self.param("decoder_bias", nn.with_partitioning(
+            nn.initializers.zeros, ("vocab",)),
+            (cfg.padded_vocab_size,), cfg.param_dtype)
+        logits = logits + decoder_bias.astype(cfg.dtype)
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+            logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+        nsp_logits = _dense(pooled, 2, ("embed", None), cfg=cfg,
+                            name="seq_relationship", module=self)
+
+        out = ModelOutput(logits=logits, nsp_logits=nsp_logits)
+        if labels is not None:
+            loss = cross_entropy_loss(logits, labels)
+            if next_sentence_label is not None:
+                loss = loss + cross_entropy_loss(
+                    nsp_logits.astype(jnp.float32), next_sentence_label)
+            out["loss"] = loss
+        return out
+
+    def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
+        S = seq_len or min(self.cfg.max_position_embeddings, 128)
+        ids = jnp.zeros((batch_size, S), jnp.int32)
+        return {"input_ids": ids, "labels": jnp.full((batch_size, S), -100, jnp.int32)}
+
+    def flops_per_token(self) -> float:
+        cfg = self.cfg
+        E, L = cfg.hidden_size, cfg.num_hidden_layers
+        n_params = (cfg.padded_vocab_size * E + cfg.max_position_embeddings * E
+                    + L * (4 * E * E + 2 * E * cfg.intermediate_size))
+        attn = 12 * L * E * cfg.max_position_embeddings
+        return 6.0 * n_params + attn

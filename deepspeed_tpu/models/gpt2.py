@@ -46,6 +46,10 @@ class GPT2Config:
     attn_impl: str = "auto"            # auto | jnp | flash | ring
     vocab_pad_multiple: int = 128      # MXU/TP-friendly vocab padding
     decode: bool = False               # KV-cache autoregressive mode
+    # Mixture-of-Experts FFN (reference deepspeed/moe usage: MoE replaces
+    # the MLP).  With scan_layers the stack is homogeneous, so MoE applies
+    # to EVERY block (use use_residual=True for the PR-MoE dense+MoE mix).
+    moe: Optional[Any] = None          # parallel.moe.MoEConfig
 
     @property
     def padded_vocab_size(self) -> int:
@@ -196,12 +200,32 @@ class Block(nn.Module):
     deterministic: bool = True
 
     @nn.compact
-    def __call__(self, x, attn_mask):
-        x = x + SelfAttention(self.cfg, name="attn")(
-            LayerNorm(self.cfg, name="ln_1")(x), attn_mask, self.deterministic)
-        x = x + MLP(self.cfg, name="mlp")(
-            LayerNorm(self.cfg, name="ln_2")(x), self.deterministic)
-        return x, None
+    def __call__(self, x, inputs):
+        attn_mask, pld_theta = inputs if isinstance(inputs, tuple) else (inputs, None)
+
+        def survive(branch):
+            # stochastic depth (PLD, reference progressive_layer_drop.py):
+            # keep residual branch with prob theta, rescale to keep E[x]
+            if pld_theta is None or self.deterministic:
+                return branch
+            keep = jax.random.bernoulli(self.make_rng("pld"), pld_theta)
+            scaled = branch / pld_theta.astype(branch.dtype)
+            return jnp.where(keep, scaled, jnp.zeros_like(branch))
+
+        x = x + survive(SelfAttention(self.cfg, name="attn")(
+            LayerNorm(self.cfg, name="ln_1")(x), attn_mask, self.deterministic))
+        h = LayerNorm(self.cfg, name="ln_2")(x)
+        if self.cfg.moe is not None:
+            from ..parallel.moe import MoELayer
+
+            ff, aux = MoELayer(self.cfg.moe, model_dim=self.cfg.n_embd,
+                               hidden_dim=4 * self.cfg.n_embd,
+                               dtype=self.cfg.dtype, name="moe")(
+                h, train=not self.deterministic)
+            x = x + survive(ff)
+            return x, aux
+        x = x + survive(MLP(self.cfg, name="mlp")(h, self.deterministic))
+        return x, jnp.zeros((), jnp.float32)
 
 
 class GPT2LMHeadModel(nn.Module):
@@ -218,7 +242,8 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
-                 labels=None, deterministic: bool = True, shift: bool = True):
+                 labels=None, deterministic: bool = True, shift: bool = True,
+                 layer_drop_theta=None):
         cfg = self.cfg
         B, S = input_ids.shape
 
@@ -251,20 +276,26 @@ class GPT2LMHeadModel(nn.Module):
             stack = nn.scan(
                 block_cls,
                 variable_axes={"params": 0, "cache": 0},
-                split_rngs={"params": True, "dropout": True},
+                split_rngs={"params": True, "dropout": True, "gating": True,
+                            "pld": True},
                 length=cfg.n_layer,
                 in_axes=nn.broadcast,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
             )
-            h, _ = stack(cfg, deterministic, name="h")(h, mask)
+            h, layer_aux = stack(cfg, deterministic, name="h")(
+                h, (mask, layer_drop_theta))
+            aux_loss = layer_aux.sum()
         else:
+            aux_loss = jnp.zeros((), jnp.float32)
             for i in range(cfg.n_layer):
                 block_cls = Block
                 if cfg.remat:
                     block_cls = nn.remat(
                         Block, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
                         prevent_cse=False)
-                h, _ = block_cls(cfg, deterministic, name=f"h_{i}")(h, mask)
+                h, aux = block_cls(cfg, deterministic, name=f"h_{i}")(
+                    h, (mask, layer_drop_theta))
+                aux_loss = aux_loss + aux
 
         h = LayerNorm(cfg, name="ln_f")(h)
         logits = jnp.dot(h, wte.astype(cfg.dtype).T)
@@ -274,9 +305,14 @@ class GPT2LMHeadModel(nn.Module):
             logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
 
         out = ModelOutput(logits=logits)
+        if cfg.moe is not None:
+            out["aux_loss"] = aux_loss
         if labels is not None:
             tgt = shift_labels(labels) if shift else labels
-            out["loss"] = cross_entropy_loss(logits, tgt)
+            loss = cross_entropy_loss(logits, tgt)
+            if cfg.moe is not None:
+                loss = loss + aux_loss  # load-balancing loss (engine.py:2154 analog)
+            out["loss"] = loss
         return out
 
     # -- pipeline decomposition (parallel/pipeline.py contract) --------
@@ -291,6 +327,10 @@ class GPT2LMHeadModel(nn.Module):
         cfg = self.cfg
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires scan_layers=True")
+        if cfg.moe is not None:
+            raise NotImplementedError(
+                "MoE + pipeline parallelism: the aux loss does not flow "
+                "through the pipeline loop yet; use ep with dp/fsdp/tp")
         if cfg.n_layer % n_stages != 0:
             raise ValueError(f"n_layer {cfg.n_layer} not divisible by pp={n_stages}")
         local_layers = cfg.n_layer // n_stages

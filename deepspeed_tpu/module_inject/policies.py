@@ -118,3 +118,109 @@ def _tree_f32(x):
     if isinstance(x, dict):
         return {k: _tree_f32(v) for k, v in x.items()}
     return np.asarray(x, dtype=np.float32)
+
+
+@register_policy("Bert")
+def convert_hf_bert(hf_model, dtype=None):
+    """HF BERT (BertForPreTraining/BertForMaskedLM/BertModel) → zoo BERT
+    (policy analog of ``replace_policy.py:50`` ``HFBertLayerPolicy``).
+
+    torch ``nn.Linear`` stores (out, in); our kernels are (in, out) → every
+    linear transposes.  Per-layer q/k/v fuse into one (in, 3·out) kernel.
+    """
+    import jax.numpy as jnp
+
+    from ..models.bert import BertConfig, BertForPreTraining
+
+    hc = hf_model.config
+    cfg = BertConfig(
+        vocab_size=hc.vocab_size,
+        hidden_size=hc.hidden_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        intermediate_size=hc.intermediate_size,
+        max_position_embeddings=hc.max_position_embeddings,
+        type_vocab_size=hc.type_vocab_size,
+        layer_norm_eps=hc.layer_norm_eps,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        scan_layers=True,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    # strip leading "bert." if present (BertModel alone has no prefix)
+    if not any(k.startswith("bert.") for k in sd):
+        sd = {f"bert.{k}": v for k, v in sd.items()}
+    L = cfg.num_hidden_layers
+
+    def lin_t(fmt):  # (out,in) -> stacked (L, in, out)
+        return np.stack([sd[fmt.format(i)].T for i in range(L)])
+
+    def vec(fmt):
+        return np.stack([sd[fmt.format(i)] for i in range(L)])
+
+    qkv_kernel = np.concatenate([
+        lin_t("bert.encoder.layer.{}.attention.self.query.weight"),
+        lin_t("bert.encoder.layer.{}.attention.self.key.weight"),
+        lin_t("bert.encoder.layer.{}.attention.self.value.weight")], axis=2)
+    qkv_bias = np.concatenate([
+        vec("bert.encoder.layer.{}.attention.self.query.bias"),
+        vec("bert.encoder.layer.{}.attention.self.key.bias"),
+        vec("bert.encoder.layer.{}.attention.self.value.bias")], axis=1)
+
+    word = sd["bert.embeddings.word_embeddings.weight"].astype(np.float32)
+    if cfg.padded_vocab_size != cfg.vocab_size:
+        pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size,
+                        cfg.hidden_size), np.float32)
+        word = np.concatenate([word, pad], axis=0)
+
+    bert_params = {
+        "word_embeddings": word,
+        "position_embeddings": sd["bert.embeddings.position_embeddings.weight"],
+        "token_type_embeddings": sd["bert.embeddings.token_type_embeddings.weight"],
+        "embeddings_ln": {"scale": sd["bert.embeddings.LayerNorm.weight"],
+                          "bias": sd["bert.embeddings.LayerNorm.bias"]},
+        "encoder": {
+            "attention": {
+                "qkv_kernel": qkv_kernel,
+                "qkv_bias": qkv_bias,
+                "output_kernel": lin_t(
+                    "bert.encoder.layer.{}.attention.output.dense.weight"),
+                "output_bias": vec(
+                    "bert.encoder.layer.{}.attention.output.dense.bias"),
+            },
+            "attention_ln": {
+                "scale": vec("bert.encoder.layer.{}.attention.output.LayerNorm.weight"),
+                "bias": vec("bert.encoder.layer.{}.attention.output.LayerNorm.bias")},
+            "intermediate_kernel": lin_t(
+                "bert.encoder.layer.{}.intermediate.dense.weight"),
+            "intermediate_bias": vec("bert.encoder.layer.{}.intermediate.dense.bias"),
+            "output_kernel": lin_t("bert.encoder.layer.{}.output.dense.weight"),
+            "output_bias": vec("bert.encoder.layer.{}.output.dense.bias"),
+            "output_ln": {
+                "scale": vec("bert.encoder.layer.{}.output.LayerNorm.weight"),
+                "bias": vec("bert.encoder.layer.{}.output.LayerNorm.bias")},
+        },
+    }
+    if "bert.pooler.dense.weight" in sd:
+        bert_params["pooler_kernel"] = sd["bert.pooler.dense.weight"].T
+        bert_params["pooler_bias"] = sd["bert.pooler.dense.bias"]
+
+    params = {"bert": bert_params}
+    # MLM head (present on ForPreTraining / ForMaskedLM)
+    if "cls.predictions.transform.dense.weight" in sd:
+        params["transform_kernel"] = sd["cls.predictions.transform.dense.weight"].T
+        params["transform_bias"] = sd["cls.predictions.transform.dense.bias"]
+        params["transform_ln"] = {
+            "scale": sd["cls.predictions.transform.LayerNorm.weight"],
+            "bias": sd["cls.predictions.transform.LayerNorm.bias"]}
+        dec_bias = sd["cls.predictions.bias"].astype(np.float32)
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            dec_bias = np.concatenate(
+                [dec_bias, np.zeros(cfg.padded_vocab_size - cfg.vocab_size,
+                                    np.float32)])
+        params["decoder_bias"] = dec_bias
+    if "cls.seq_relationship.weight" in sd:
+        params["seq_relationship_kernel"] = sd["cls.seq_relationship.weight"].T
+        params["seq_relationship_bias"] = sd["cls.seq_relationship.bias"]
+
+    logger.info(f"converted HF BERT ({L}L, {cfg.hidden_size}d) to zoo params")
+    return BertForPreTraining(cfg), _tree_f32(params)

@@ -47,7 +47,15 @@ def dot_product_attention(
     scale: Optional[float] = None,
     impl: str = "auto",
 ) -> jax.Array:
-    """Multi-head scaled dot-product attention; returns ``(B, S, H, D)``."""
+    """Multi-head scaled dot-product attention; returns ``(B, S, H, D)``.
+
+    ``impl="ring"`` / ``"ulysses"`` are the sequence-parallel paths: the
+    sequence dim must be sharded on the ``sp`` mesh axis (the engine does
+    this when ``mesh sp > 1``); a partial-manual shard_map runs the ring /
+    all-to-all exchange while every other axis stays automatic.
+    """
+    if impl in ("ring", "ulysses"):
+        return _sp_attention(q, k, v, causal=causal, scale=scale, kind=impl)
     impl = _pick_impl(impl, q)
     if impl == "flash" and bias is None and mask is None and dropout_rate == 0.0:
         try:
@@ -59,6 +67,33 @@ def dot_product_attention(
     return _jnp_attention(q, k, v, causal=causal, bias=bias, mask=mask,
                           dropout_rate=dropout_rate, dropout_rng=dropout_rng,
                           scale=scale)
+
+
+def _sp_attention(q, k, v, *, causal, scale, kind):
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.mesh import get_mesh
+
+    mesh = get_mesh(required=False)
+    if mesh is None or mesh.shape.get("sp", 1) == 1:
+        # no sequence-parallel axis: plain attention
+        return _jnp_attention(q, k, v, causal=causal, bias=None, mask=None,
+                              dropout_rate=0.0, dropout_rng=None, scale=scale)
+    from ..parallel.ring_attention import ring_attention, ulysses_attention
+
+    fn = ring_attention if kind == "ring" else ulysses_attention
+    mapped = shard_map(
+        partial(fn, axis_name="sp", causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        axis_names={"sp"},
+        check_vma=False,
+    )
+    return mapped(q, k, v)
 
 
 def _jnp_attention(q, k, v, *, causal, bias, mask, dropout_rate, dropout_rng, scale):

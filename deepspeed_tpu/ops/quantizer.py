@@ -1,0 +1,82 @@
+"""Grouped quantization ops.
+
+Kernel-parity analog of reference ``csrc/quantization/quantizer.cu`` (1037
+LoC: ``ds_quantize_*`` grouped symmetric/asymmetric + ``ds_sr_quantize_*``
+stochastic-rounding variants, bound in ``pt_binding.cpp:64-74``).  On TPU
+these are jnp programs XLA fuses into adjacent ops; the API mirrors the
+kernel set: symmetric/asymmetric × deterministic/stochastic, group-wise
+over the last-dim reshape.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jax.Array, groups: int):
+    n = x.size
+    if n % groups:
+        raise ValueError(f"size {n} not divisible by groups {groups}")
+    return x.reshape(groups, n // groups)
+
+
+def quantize_symmetric(x: jax.Array, bits: int, groups: int = 1,
+                       stochastic_rng: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """→ (int8-ish codes, per-group scale); codes in [-(2^{b-1}-1), +...]."""
+    orig_shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qmax = 2.0 ** (bits - 1) - 1.0
+    scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax
+    scale = jnp.maximum(scale, 1e-12)
+    y = g / scale
+    if stochastic_rng is not None:
+        y = jnp.floor(y + jax.random.uniform(stochastic_rng, y.shape))
+    else:
+        y = jnp.round(y)
+    y = jnp.clip(y, -qmax, qmax)
+    return y.reshape(orig_shape).astype(jnp.int8 if bits <= 8 else jnp.int32), \
+        scale.squeeze(1)
+
+
+def dequantize_symmetric(codes: jax.Array, scale: jax.Array, groups: int,
+                         dtype=jnp.float32) -> jax.Array:
+    g = _grouped(codes.astype(jnp.float32), groups)
+    return (g * scale[:, None]).reshape(codes.shape).astype(dtype)
+
+
+def quantize_asymmetric(x: jax.Array, bits: int, groups: int = 1,
+                        stochastic_rng: Optional[jax.Array] = None):
+    """→ (codes in [0, 2^b - 1], scale, zero_point)."""
+    orig_shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qmax = 2.0 ** bits - 1.0
+    lo = g.min(axis=1, keepdims=True)
+    hi = g.max(axis=1, keepdims=True)
+    scale = jnp.maximum((hi - lo) / qmax, 1e-12)
+    y = (g - lo) / scale
+    if stochastic_rng is not None:
+        y = jnp.floor(y + jax.random.uniform(stochastic_rng, y.shape))
+    else:
+        y = jnp.round(y)
+    y = jnp.clip(y, 0.0, qmax)
+    return (y.reshape(orig_shape).astype(jnp.int32), scale.squeeze(1),
+            lo.squeeze(1))
+
+
+def dequantize_asymmetric(codes, scale, zero_point, groups, dtype=jnp.float32):
+    g = _grouped(codes.astype(jnp.float32), groups)
+    return (g * scale[:, None] + zero_point[:, None]).reshape(
+        codes.shape).astype(dtype)
+
+
+def fake_quantize(x: jax.Array, bits: int, groups: int = 1, symmetric: bool = True,
+                  stochastic_rng: Optional[jax.Array] = None) -> jax.Array:
+    """Quantize→dequantize in the original dtype (the MoQ training op)."""
+    if symmetric:
+        codes, scale = quantize_symmetric(x, bits, groups, stochastic_rng)
+        return dequantize_symmetric(codes, scale, groups, x.dtype)
+    codes, scale, zp = quantize_asymmetric(x, bits, groups, stochastic_rng)
+    return dequantize_asymmetric(codes, scale, zp, groups, x.dtype)

@@ -1,0 +1,57 @@
+"""Curriculum learning scheduler.
+
+Analog of reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler``): a step-driven difficulty value (sequence length)
+with ``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` schedules.  The
+engine truncates each batch's sequence dim to the current difficulty
+(reference injects ``curriculum_seqlen`` into forward, ``engine.py:1560``).
+Pure host math.
+"""
+from __future__ import annotations
+
+
+class CurriculumScheduler:
+    def __init__(self, config: dict):
+        self.state = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config missing '{key}'")
+        if config["curriculum_type"] != "seqlen":
+            raise ValueError("only curriculum_type='seqlen' is supported")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        cfg = config.get("schedule_config", {})
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            self.total_steps = int(cfg["total_curriculum_step"])
+            self.difficulty_step = int(cfg.get("difficulty_step", 8))
+            self.root_degree = int(cfg.get("root_degree", 2)) \
+                if self.schedule_type == "fixed_root" else 1
+        elif self.schedule_type == "fixed_discrete":
+            self.difficulties = list(cfg["difficulty"])
+            self.max_steps = list(cfg["max_step"])
+            if len(self.difficulties) != len(self.max_steps) + 1:
+                raise ValueError("need len(difficulty) == len(max_step) + 1")
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+        self.current_difficulty = self.min_difficulty
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == "fixed_discrete":
+            diff = self.difficulties[-1]
+            for d, boundary in zip(self.difficulties, self.max_steps):
+                if global_steps < boundary:
+                    diff = d
+                    break
+            return diff
+        frac = min(global_steps / max(self.total_steps, 1), 1.0)
+        if self.schedule_type == "fixed_root":
+            frac = frac ** (1.0 / self.root_degree)
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        diff = int(diff - diff % self.difficulty_step)
+        return max(self.min_difficulty, min(diff, self.max_difficulty))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty

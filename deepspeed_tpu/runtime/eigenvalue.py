@@ -1,0 +1,65 @@
+"""Hessian top-eigenvalue estimation by power iteration.
+
+Analog of reference ``runtime/eigenvalue.py:61`` (``Eigenvalue
+.compute_eigenvalue``) which needs ``create_graph=True`` double backward
+(engine.py:1699) and hand-rolled per-block power iteration.  In JAX the
+Hessian-vector product is one ``jvp(grad(f))`` — no graph retention, works
+under jit, and runs per-module by masking the vector to a sub-tree.
+
+Feeds the MoQ quantization schedule (``runtime/quantize.py``) with relative
+layer sensitivity, as in the reference.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _normalize(tree):
+    norm = jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2)
+                        for l in jax.tree_util.tree_leaves(tree)))
+    norm = jnp.maximum(norm, 1e-12)
+    return jax.tree_util.tree_map(lambda l: l / norm, tree), norm
+
+
+def compute_eigenvalue(loss_fn: Callable, params, *args, num_iter: int = 10,
+                       rng: Optional[jax.Array] = None, tol: float = 1e-2):
+    """Top Hessian eigenvalue of ``loss_fn(params, *args)`` w.r.t. params."""
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    v = jax.tree_util.tree_unflatten(
+        treedef, [jax.random.normal(k, l.shape, jnp.float32)
+                  for k, l in zip(keys, leaves)])
+    v, _ = _normalize(v)
+
+    grad_fn = jax.grad(lambda p: loss_fn(p, *args))
+
+    def hvp(vec):
+        return jax.jvp(grad_fn, (params,), (vec,))[1]
+
+    eig = jnp.float32(0.0)
+    for _ in range(num_iter):
+        hv = hvp(v)
+        v, eig = _normalize(hv)
+    return eig
+
+
+def layer_eigenvalues(loss_fn: Callable, params: dict, *args,
+                      num_iter: int = 8) -> dict:
+    """Per-top-level-module eigenvalues (the reference's block layer_num
+    loop), via sub-tree extraction so each power iteration only perturbs
+    one module."""
+    out = {}
+    for name in params:
+        def sub_loss(sub, *a):
+            merged = dict(params)
+            merged[name] = sub
+            return loss_fn(merged, *a)
+
+        out[name] = compute_eigenvalue(sub_loss, params[name], *args,
+                                       num_iter=num_iter)
+    return out

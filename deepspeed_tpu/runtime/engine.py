@@ -127,6 +127,31 @@ class Engine:
 
         self.monitor = MonitorMaster(self.config.monitor)
 
+        # ---- aux training features (reference engine.py:331-347) ------
+        self.curriculum_scheduler = None
+        if self.config.curriculum_learning.get("enabled"):
+            from .curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                self.config.curriculum_learning)
+        self.progressive_layer_drop = None
+        if self.config.progressive_layer_drop.get("enabled"):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            if self.pp_size > 1:
+                raise NotImplementedError(
+                    "progressive layer drop is not supported with pipeline "
+                    "parallelism (stochastic depth would unbalance stages)")
+            pld_cfg = self.config.progressive_layer_drop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
+        self.quantizer = None
+        if self.config.quantize_training.get("enabled"):
+            from .quantize import QuantizeConfig, Quantizer
+
+            self.quantizer = Quantizer(
+                QuantizeConfig.from_dict(self.config.quantize_training))
+
         if model_parameters is not None:
             self.init_params(params=model_parameters)
 
@@ -204,11 +229,17 @@ class Engine:
             return False
         return "deterministic" in sig.parameters
 
-    def _loss_fn(self, params, batch, rng, deterministic: bool):
+    def _loss_fn(self, params, batch, rng, deterministic: bool, pld_theta=None):
         if self._user_loss_fn is not None:
             return self._user_loss_fn(params, batch, rng)
-        rngs = {"dropout": rng} if rng is not None else {}
+        rngs = {}
+        if rng is not None:
+            rngs = {"dropout": rng,
+                    "gating": jax.random.fold_in(rng, 1),
+                    "pld": jax.random.fold_in(rng, 2)}
         kwargs = dict(batch)
+        if pld_theta is not None:
+            kwargs["layer_drop_theta"] = pld_theta
         if self._model_takes_deterministic:
             kwargs["deterministic"] = deterministic
         out = self.model.apply({"params": params}, rngs=rngs, **kwargs)
@@ -294,11 +325,12 @@ class Engine:
     # ------------------------------------------------------------------
     # compiled pieces
     # ------------------------------------------------------------------
-    def _grads_of(self, params, batch, rng, scale):
+    def _grads_of(self, params, batch, rng, scale, pld_theta=None):
         """(scaled loss, fp32 grads) on one global micro-batch."""
 
         def scaled_loss_fn(p):
-            loss = self._loss_fn(p, batch, rng, deterministic=False)
+            loss = self._loss_fn(p, batch, rng, deterministic=False,
+                                 pld_theta=pld_theta)
             return loss * scale
 
         loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
@@ -314,6 +346,13 @@ class Engine:
         grad_norm = optax.global_norm(grads)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if self.quantizer is not None:
+            # MoQ: fake-quantize weights at the scheduled precision after the
+            # update (reference runtime/quantize.py in-place kernel pass)
+            qrng = (jax.random.fold_in(
+                        jax.random.fold_in(self._base_rng, 0x4D6F51), state.step)
+                    if self.quantizer.cfg.rounding == "stochastic" else None)
+            new_params = self.quantizer.quantize_params(new_params, state.step, qrng)
         mean_loss = loss_sum / (denom * scale) if loss_is_scaled else loss_sum / denom
         metrics = {"loss": mean_loss, "grad_norm": grad_norm,
                    "lr": self.lr_scheduler(state.step)}
@@ -370,8 +409,10 @@ class Engine:
             return self._compiled_pipeline_step
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
+        pld_on = self.progressive_layer_drop is not None
 
-        def step_fn(state: TrainState, batch):
+        def step_fn(state: TrainState, batch, *extra):
+            pld_theta = extra[0] if pld_on else None
             rng = jax.random.fold_in(self._base_rng, state.step)
             scale = state.loss_scale.scale if cfg.fp16.enabled else jnp.float32(1.0)
             if gas > 1:
@@ -380,7 +421,8 @@ class Engine:
                 def body(carry, mb):
                     g_acc, l_acc, i = carry
                     mb_rng = jax.random.fold_in(rng, i)
-                    loss, grads = self._grads_of(state.params, mb, mb_rng, scale)
+                    loss, grads = self._grads_of(state.params, mb, mb_rng, scale,
+                                                 pld_theta)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
                     g_acc = self._constrain(g_acc, self._grad_specs)
                     return (g_acc, l_acc + loss, i + 1), None
@@ -392,7 +434,7 @@ class Engine:
                     body, (zeros, jnp.float32(0.0), jnp.int32(0)), mbs)
             else:
                 loss_sum, g_sum = self._grads_of(
-                    state.params, batch, rng, scale)
+                    state.params, batch, rng, scale, pld_theta)
                 g_sum = self._constrain(g_sum, self._grad_specs)
             return self._apply_grads(state, g_sum, loss_sum, jnp.float32(gas))
 
@@ -465,14 +507,19 @@ class Engine:
     # public API
     # ------------------------------------------------------------------
     def _shard_batch(self, batch):
+        sp = self.mesh.shape["sp"]
+
         def put(x):
             if np.ndim(x) == 0 or np.shape(x)[0] % self.dp_world != 0:
                 raise ValueError(
                     f"batch leading dim {np.shape(x)} must be divisible by the "
                     f"data-parallel world size {self.dp_world} "
                     f"(mesh dp×fsdp×ep); expected a multiple of {self.dp_world} rows")
-            sharding = NamedSharding(
-                self.mesh, P(DATA_AXES, *([None] * (np.ndim(x) - 1))))
+            dims = [DATA_AXES] + [None] * (np.ndim(x) - 1)
+            # sequence parallelism: shard the seq dim over 'sp'
+            if sp > 1 and np.ndim(x) >= 2 and np.shape(x)[1] % sp == 0:
+                dims[1] = "sp"
+            sharding = NamedSharding(self.mesh, P(*dims))
             return jax.device_put(jnp.asarray(x), sharding)
 
         return jax.tree_util.tree_map(put, batch)
@@ -502,9 +549,20 @@ class Engine:
                 return (y.transpose(1, 0, 2, *range(3, y.ndim))
                          .reshape(b, *x.shape[1:]))
             batch = jax.tree_util.tree_map(relayout, batch)
+        if self.curriculum_scheduler is not None:
+            # truncate seq dim to the scheduled difficulty (reference
+            # engine.py:1560 curriculum_seqlen injection)
+            seqlen = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            batch = jax.tree_util.tree_map(
+                lambda x: x[:, :seqlen] if np.ndim(x) >= 2 else x, batch)
+        extra = ()
+        if self.progressive_layer_drop is not None:
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            extra = (jnp.float32(theta),)
         batch = self._shard_batch(batch)
         self._tput.start()
-        self._state, metrics = self._compiled_train_step(self._state, batch)
+        self._state, metrics = self._compiled_train_step(self._state, batch, *extra)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         self.global_samples += self.train_batch_size

@@ -1,0 +1,29 @@
+"""Progressive layer drop (PLD).
+
+Analog of reference ``runtime/progressive_layer_drop.py:5``
+(``ProgressiveLayerDrop``): keep-probability theta anneals from 1 toward
+``theta`` with rate ``gamma``; the engine passes the current theta into the
+model forward (reference ``engine.py:1554``), where stochastic depth drops
+residual branches (zoo models consume it via ``layer_drop_theta``).
+"""
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}

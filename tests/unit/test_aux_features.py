@@ -1,0 +1,172 @@
+"""Curriculum / PLD / MoQ / eigenvalue / quantizer / profiler tests —
+analogs of reference ``test_curriculum_learning.py``, ``test_pld.py``,
+``test_flops_profiler.py`` and the quantizer kernel tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+from deepspeed_tpu.runtime.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+from .simple_model import SimpleModel, token_batch
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+# ------------------------- pure-math schedules -------------------------
+
+def test_curriculum_fixed_linear():
+    sched = CurriculumScheduler({
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 8, "max_difficulty": 64, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert sched.get_difficulty(0) == 8
+    assert sched.get_difficulty(50) == 32  # midpoint, rounded to step
+    assert sched.get_difficulty(100) == 64
+    assert sched.get_difficulty(10**6) == 64
+
+
+def test_curriculum_fixed_discrete():
+    sched = CurriculumScheduler({
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 8, "max_difficulty": 32, "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [8, 16, 32], "max_step": [10, 20]}})
+    assert sched.get_difficulty(5) == 8
+    assert sched.get_difficulty(15) == 16
+    assert sched.get_difficulty(25) == 32
+
+
+def test_pld_theta_anneals():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    t0 = pld.update_state(0)
+    t100 = pld.update_state(100)
+    t10000 = pld.update_state(10000)
+    assert t0 == pytest.approx(1.0)
+    assert t0 > t100 > t10000
+    assert t10000 == pytest.approx(0.5, abs=1e-3)
+
+
+# ------------------------- engine integration -------------------------
+
+def test_curriculum_truncates_seq():
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny"))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 16, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 16}}})
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 64, 512)
+    for _ in range(5):
+        loss = engine.train_batch(batch)
+    assert np.isfinite(float(loss))
+    assert engine.curriculum_scheduler.current_difficulty == 64
+
+
+def test_pld_trains():
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny"))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.01}})
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 32, 512)
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+
+
+def test_moq_quantizes_weights():
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "quantize_training": {"enabled": True, "start_bits": 16,
+                              "target_bits": 4, "quantize_period": 2,
+                              "quantize_groups": 1}})
+    engine.init_params()
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(16, 16)).astype(np.float32),
+             "y": np.zeros((16, 16), np.float32)}
+    for _ in range(8):  # past bits ladder: 16→8 at step 2, →4 at step 6
+        engine.train_batch(batch)
+    kernel = np.asarray(jax.device_get(engine.params["linear_0"]["kernel"]))
+    # 4-bit symmetric: at most 15 distinct levels per group
+    assert len(np.unique(np.round(kernel / (np.abs(kernel).max() / 7), 6))) <= 16
+
+
+def test_quantizer_roundtrip():
+    from deepspeed_tpu.ops.quantizer import (
+        dequantize_symmetric, fake_quantize, quantize_symmetric)
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 64)), jnp.float32)
+    codes, scale = quantize_symmetric(x, bits=8, groups=4)
+    back = dequantize_symmetric(codes, scale, groups=4)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=2e-2)
+    fq = fake_quantize(x, bits=8, groups=4)
+    np.testing.assert_allclose(np.asarray(fq), np.asarray(back))
+    # asymmetric handles shifted data better
+    from deepspeed_tpu.ops.quantizer import fake_quantize as fq2
+
+    shifted = x + 10.0
+    err_sym = np.abs(np.asarray(fq2(shifted, 4, 4, symmetric=True) - shifted)).mean()
+    err_asym = np.abs(np.asarray(fq2(shifted, 4, 4, symmetric=False) - shifted)).mean()
+    assert err_asym < err_sym
+
+
+def test_eigenvalue_power_iteration():
+    from deepspeed_tpu.runtime.eigenvalue import compute_eigenvalue
+
+    # quadratic loss: f(w) = 0.5 w^T A w → top eigenvalue of A
+    evals = np.array([5.0, 2.0, 1.0], np.float32)
+    A = np.diag(evals)
+
+    def loss(params):
+        w = params["w"]
+        return 0.5 * w @ jnp.asarray(A) @ w
+
+    eig = compute_eigenvalue(loss, {"w": jnp.ones(3)}, num_iter=30)
+    assert float(eig) == pytest.approx(5.0, rel=1e-3)
+
+
+def test_flops_profiler_matmul():
+    from deepspeed_tpu.profiling import profile_compiled
+
+    a = jnp.zeros((128, 256), jnp.float32)
+    b = jnp.zeros((256, 512), jnp.float32)
+    costs = profile_compiled(lambda a, b: a @ b, a, b)
+    assert costs["flops"] == pytest.approx(2 * 128 * 256 * 512, rel=0.01)
+
+
+def test_flops_profiler_engine():
+    from deepspeed_tpu.profiling import FlopsProfiler
+
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny"))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 32, 512)
+    engine.train_batch(batch)  # compile
+    prof = FlopsProfiler(engine)
+    prof.start_profile(batch)
+    prof.step_begin()
+    loss = engine.train_batch(batch)
+    prof.step_end(loss)
+    prof.stop_profile()
+    s = prof.summary()
+    assert s["total_params"] > 0
+    assert s["flops"] > 0
+    assert s["mean_step_ms"] > 0
+    prof.print_profile()
