@@ -1,0 +1,146 @@
+// Asynchronous file I/O engine with a pinned thread pool.
+//
+// TPU-native analog of the reference's libaio NVMe engine
+// (csrc/aio/py_lib/deepspeed_aio_thread.cpp, deepspeed_py_aio_handle.cpp,
+// py_ds_aio.cpp bindings): a fixed pool of worker threads services
+// read/write requests against files, so optimizer-state / parameter swaps
+// to NVMe overlap with device compute.  POSIX pread/pwrite instead of
+// libaio (portable, and the thread pool gives the same queue-depth
+// parallelism the reference gets from aio contexts).
+//
+// C ABI for ctypes.  Tickets are monotonically increasing request ids.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int64_t ticket;
+  bool is_write;
+  std::string path;
+  void* buf;
+  int64_t nbytes;
+  int64_t offset;
+};
+
+struct AioHandle {
+  std::vector<std::thread> workers;
+  std::deque<Request> queue;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::condition_variable done_cv;
+  std::unordered_map<int64_t, int> results;  // ticket -> 0 ok / errno
+  std::atomic<int64_t> next_ticket{1};
+  int64_t inflight = 0;
+  bool shutdown = false;
+
+  void worker_loop() {
+    for (;;) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return shutdown || !queue.empty(); });
+        if (shutdown && queue.empty()) return;
+        req = queue.front();
+        queue.pop_front();
+      }
+      int rc = run(req);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        results[req.ticket] = rc;
+        inflight--;
+        done_cv.notify_all();
+      }
+    }
+  }
+
+  static int run(const Request& req) {
+    int flags = req.is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+    int fd = ::open(req.path.c_str(), flags, 0644);
+    if (fd < 0) return errno ? errno : -1;
+    int64_t done = 0;
+    int rc = 0;
+    while (done < req.nbytes) {
+      ssize_t r = req.is_write
+          ? ::pwrite(fd, static_cast<char*>(req.buf) + done,
+                     req.nbytes - done, req.offset + done)
+          : ::pread(fd, static_cast<char*>(req.buf) + done,
+                    req.nbytes - done, req.offset + done);
+      if (r <= 0) { rc = errno ? errno : -1; break; }
+      done += r;
+    }
+    ::close(fd);
+    return rc;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* aio_create(int num_threads) {
+  auto* h = new AioHandle();
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i)
+    h->workers.emplace_back([h] { h->worker_loop(); });
+  return h;
+}
+
+int64_t aio_submit(void* handle, const char* path, void* buf, int64_t nbytes,
+                   int64_t offset, int is_write) {
+  auto* h = static_cast<AioHandle*>(handle);
+  int64_t ticket = h->next_ticket.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->queue.push_back(Request{ticket, is_write != 0, path, buf, nbytes, offset});
+    h->inflight++;
+  }
+  h->cv.notify_one();
+  return ticket;
+}
+
+// Blocks until the given ticket completes; returns its status (0 = ok).
+int aio_wait(void* handle, int64_t ticket) {
+  auto* h = static_cast<AioHandle*>(handle);
+  std::unique_lock<std::mutex> lk(h->mu);
+  h->done_cv.wait(lk, [&] { return h->results.count(ticket) > 0; });
+  int rc = h->results[ticket];
+  h->results.erase(ticket);
+  return rc;
+}
+
+// Blocks until the queue drains; returns first nonzero status if any.
+int aio_wait_all(void* handle) {
+  auto* h = static_cast<AioHandle*>(handle);
+  std::unique_lock<std::mutex> lk(h->mu);
+  h->done_cv.wait(lk, [&] { return h->inflight == 0; });
+  int rc = 0;
+  for (auto& kv : h->results)
+    if (kv.second != 0) { rc = kv.second; break; }
+  h->results.clear();
+  return rc;
+}
+
+void aio_destroy(void* handle) {
+  auto* h = static_cast<AioHandle*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->shutdown = true;
+  }
+  h->cv.notify_all();
+  for (auto& t : h->workers) t.join();
+  delete h;
+}
+
+}  // extern "C"

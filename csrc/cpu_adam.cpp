@@ -1,0 +1,70 @@
+// Native CPU optimizer kernels for host-offloaded optimizer states.
+//
+// TPU-native analog of the reference's AVX-vectorized CPU Adam/Adagrad
+// (csrc/adam/cpu_adam.cpp, csrc/adagrad/cpu_adagrad.cpp, simd.h): used by
+// the ZeRO-Offload path where fp32 master params + Adam moments live in
+// host RAM and the update runs on CPU while the device holds only bf16
+// weights.  Vectorization is left to the compiler (-O3 -march=native
+// -ffast-math auto-vectorizes these straight-line loops the same way the
+// reference's hand-written AVX512/AVX256 intrinsics do).
+//
+// C ABI for ctypes; all buffers are contiguous fp32 (or fp32 grads
+// upcast by the caller).
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// One fused Adam(W) step over a flat parameter shard.
+// bias_c1 = 1 - beta1^t, bias_c2 = 1 - beta2^t (caller tracks t).
+void ds_adam_step(float* params, const float* grads, float* exp_avg,
+                  float* exp_avg_sq, int64_t n, float lr, float beta1,
+                  float beta2, float eps, float weight_decay, float bias_c1,
+                  float bias_c2, int adamw_mode) {
+  const float step_size = lr / bias_c1;
+  const float inv_sqrt_bc2 = 1.0f / std::sqrt(bias_c2);
+#pragma omp simd
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (!adamw_mode && weight_decay != 0.0f) g += weight_decay * params[i];
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_sqrt_bc2 + eps;
+    float p = params[i];
+    if (adamw_mode && weight_decay != 0.0f) p -= lr * weight_decay * p;
+    params[i] = p - step_size * m / denom;
+  }
+}
+
+// Adam step writing an extra half-precision (bf16-pattern) copy is device
+// side in this framework; the param buffer IS the master copy.
+
+void ds_adagrad_step(float* params, const float* grads, float* exp_avg_sq,
+                     int64_t n, float lr, float eps, float weight_decay) {
+#pragma omp simd
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (weight_decay != 0.0f) g += weight_decay * params[i];
+    float v = exp_avg_sq[i] + g * g;
+    exp_avg_sq[i] = v;
+    params[i] -= lr * g / (std::sqrt(v) + eps);
+  }
+}
+
+// Flat SGD w/ momentum for completeness of the host-offload family.
+void ds_sgd_step(float* params, const float* grads, float* momentum_buf,
+                 int64_t n, float lr, float momentum, float weight_decay) {
+#pragma omp simd
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (weight_decay != 0.0f) g += weight_decay * params[i];
+    float m = momentum * momentum_buf[i] + g;
+    momentum_buf[i] = m;
+    params[i] -= lr * m;
+  }
+}
+
+}  // extern "C"

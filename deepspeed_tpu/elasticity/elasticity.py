@@ -1,0 +1,101 @@
+"""Elastic batch-size scheduling (v0.1 semantics).
+
+Analog of reference ``deepspeed/elasticity/elasticity.py`` (HCN_LIST :21,
+``_get_compatible_gpus_v01`` :128, ``compute_elastic_config`` :226): pick a
+global batch size that is simultaneously divisible for MANY accelerator
+counts, so a preempted/resized job can resume with identical optimization
+math.  Candidate batches are highly-composite-number multiples of the
+allowed micro-batches; the chosen batch maximizes (by preference) batch
+size or divisibility breadth.
+
+On TPU the same math applies to chip counts; combined with this
+framework's reshard-on-restore checkpoints (``runtime/checkpointing.py``)
+any valid count can resume directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# highly composite numbers: maximally divisible candidate multipliers
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+            45360, 50400]
+
+LATEST_ELASTICITY_VERSION = 0.1
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def get_valid_gpus(batch_size: int, micro_batches: list[int],
+                   min_gpus: int, max_gpus: int) -> list[int]:
+    """Accelerator counts that can run ``batch_size`` with SOME allowed
+    micro-batch and integer gradient accumulation (reference :107)."""
+    valid = []
+    for g in range(min_gpus, max_gpus + 1):
+        for mb in micro_batches:
+            if batch_size % (g * mb) == 0:
+                valid.append(g)
+                break
+    return valid
+
+
+def get_compatible_gpus(micro_batches: list[int], max_acceptable_batch_size: int,
+                        min_gpus: int = 1, max_gpus: Optional[int] = None,
+                        prefer_larger: bool = True):
+    """Best (batch, valid_gpus) over HCN×micro candidates (reference :128)."""
+    if max_gpus is None:
+        max_gpus = max_acceptable_batch_size // min(micro_batches)
+    candidates = sorted({hcn * mb for hcn in HCN_LIST for mb in micro_batches
+                         if hcn * mb <= max_acceptable_batch_size})
+    best_batch, best_gpus = None, []
+    for batch in candidates:
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        better = len(valid) > len(best_gpus) or (
+            len(valid) == len(best_gpus) and best_batch is not None
+            and (batch > best_batch if prefer_larger else batch < best_batch))
+        if valid and (best_batch is None or better):
+            best_batch, best_gpus = batch, valid
+    if best_batch is None:
+        raise ElasticityError(
+            f"no batch size <= {max_acceptable_batch_size} works for "
+            f"micro-batches {micro_batches} on {min_gpus}-{max_gpus} chips")
+    return best_batch, best_gpus
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
+                           world_size: int = 0):
+    """Reference :226 — returns ``(final_batch_size, valid_gpus[,
+    micro_batch])``; with ``world_size`` also resolves this job's
+    micro-batch and validates membership."""
+    elastic = ds_config.get("elasticity", {})
+    if not elastic.get("enabled", False):
+        raise ElasticityError("elasticity not enabled in config")
+    version = float(elastic.get("version", LATEST_ELASTICITY_VERSION))
+    if version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityError(f"unsupported elasticity version {version}")
+    micro_batches = list(elastic["micro_batch_sizes"])
+    max_batch = int(elastic["max_train_batch_size"])
+    min_gpus = int(elastic.get("min_gpus", 1))
+    max_gpus = int(elastic.get("max_gpus", max_batch // min(micro_batches)))
+    prefer_larger = bool(elastic.get("prefer_larger_batch", True))
+
+    final_batch, valid_gpus = get_compatible_gpus(
+        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityError(
+                f"world size {world_size} not in elastic-compatible set "
+                f"{valid_gpus} for batch {final_batch}")
+        candidates = [mb for mb in micro_batches
+                      if final_batch % (world_size * mb) == 0]
+        micro = max(candidates) if prefer_larger else min(candidates)
+        return final_batch, valid_gpus, micro
+    return final_batch, valid_gpus

@@ -1,0 +1,98 @@
+"""Host (CPU) optimizers over the native kernels.
+
+Analog of reference ``deepspeed/ops/adam/cpu_adam.py``
+(``DeepSpeedCPUAdam``) and ``ops/adagrad/cpu_adagrad.py``: numpy-facing
+optimizers whose inner loop is the C++ kernel (``csrc/cpu_adam.cpp``),
+used by the ZeRO-Offload engine path where optimizer states live in host
+RAM.  Falls back to a vectorized numpy implementation when the native lib
+is unavailable (the probe shows up in ``dstpu_report``).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .native import load as _load_native
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Flat-buffer Adam(W) on host memory.
+
+    ``step(params, grads)`` updates params in place; all buffers fp32,
+    C-contiguous.
+    """
+
+    def __init__(self, param_size: int, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.t = 0
+        self.exp_avg = np.zeros(param_size, np.float32)
+        self.exp_avg_sq = np.zeros(param_size, np.float32)
+        self._lib = _load_native()
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        self.t += 1
+        lr = self.lr if lr is None else lr
+        bc1 = 1.0 - self.beta1 ** self.t
+        bc2 = 1.0 - self.beta2 ** self.t
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self._lib is not None:
+            self._lib.ds_adam_step(
+                _f32p(params), _f32p(grads), _f32p(self.exp_avg),
+                _f32p(self.exp_avg_sq), params.size,
+                ctypes.c_float(lr), ctypes.c_float(self.beta1),
+                ctypes.c_float(self.beta2), ctypes.c_float(self.eps),
+                ctypes.c_float(self.weight_decay), ctypes.c_float(bc1),
+                ctypes.c_float(bc2), int(self.adamw_mode))
+            return
+        # numpy fallback (same math)
+        g = grads
+        if not self.adamw_mode and self.weight_decay:
+            g = g + self.weight_decay * params
+        self.exp_avg *= self.beta1
+        self.exp_avg += (1 - self.beta1) * g
+        self.exp_avg_sq *= self.beta2
+        self.exp_avg_sq += (1 - self.beta2) * g * g
+        denom = np.sqrt(self.exp_avg_sq / bc2) + self.eps
+        if self.adamw_mode and self.weight_decay:
+            params -= lr * self.weight_decay * params
+        params -= (lr / bc1) * self.exp_avg / denom
+
+
+class DeepSpeedCPUAdagrad:
+    """Flat-buffer Adagrad on host memory (reference cpu_adagrad)."""
+
+    def __init__(self, param_size: int, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.exp_avg_sq = np.zeros(param_size, np.float32)
+        self._lib = _load_native()
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self._lib is not None:
+            self._lib.ds_adagrad_step(
+                _f32p(params), _f32p(grads), _f32p(self.exp_avg_sq),
+                params.size, ctypes.c_float(lr), ctypes.c_float(self.eps),
+                ctypes.c_float(self.weight_decay))
+            return
+        g = grads + (self.weight_decay * params if self.weight_decay else 0.0)
+        self.exp_avg_sq += g * g
+        params -= lr * g / (np.sqrt(self.exp_avg_sq) + self.eps)

@@ -1,0 +1,68 @@
+"""Native (C++) op loading.
+
+The op-builder analog (reference ``op_builder/builder.py``: install-time
+``DS_BUILD_*`` compile or runtime ``jit_load`` with ninja): here a single
+shared library is built from ``csrc/`` on first use with ``g++`` and cached
+beside the package; ``available()`` is the capability probe
+(``is_compatible`` analog) surfaced by ``dstpu_report``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from functools import lru_cache
+from typing import Optional
+
+from ...utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "..", "csrc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libdstpu_native.so")
+_SOURCES = ["cpu_adam.cpp", "aio.cpp"]
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile csrc/ into one shared lib (jit_load analog)."""
+    srcs = [os.path.abspath(os.path.join(_CSRC, s)) for s in _SOURCES]
+    if not all(os.path.isfile(s) for s in srcs):
+        return None
+    if not force and os.path.isfile(_LIB_PATH) and \
+            os.path.getmtime(_LIB_PATH) >= max(os.path.getmtime(s) for s in srcs):
+        return _LIB_PATH
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-march=native", "-ffast-math", "-fPIC", "-shared",
+           "-std=c++17", "-pthread", *srcs, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        detail = getattr(e, "stderr", str(e))
+        logger.warning(f"native op build failed ({detail}); using numpy fallbacks")
+        return None
+    return _LIB_PATH
+
+
+@lru_cache(None)
+def load() -> Optional[ctypes.CDLL]:
+    path = build()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+    lib.ds_adam_step.argtypes = [f32p, f32p, f32p, f32p, i64] + \
+        [ctypes.c_float] * 7 + [ctypes.c_int]
+    lib.ds_adagrad_step.argtypes = [f32p, f32p, f32p, i64] + [ctypes.c_float] * 3
+    lib.ds_sgd_step.argtypes = [f32p, f32p, f32p, i64] + [ctypes.c_float] * 3
+    lib.aio_create.restype = ctypes.c_void_p
+    lib.aio_create.argtypes = [ctypes.c_int]
+    lib.aio_submit.restype = i64
+    lib.aio_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_void_p, i64, i64, ctypes.c_int]
+    lib.aio_wait.argtypes = [ctypes.c_void_p, i64]
+    lib.aio_wait_all.argtypes = [ctypes.c_void_p]
+    lib.aio_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def available() -> bool:
+    return load() is not None

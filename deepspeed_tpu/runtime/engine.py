@@ -90,7 +90,16 @@ class Engine:
             self.lr_scheduler = get_lr_schedule(
                 self.config.scheduler.type, self.config.scheduler.params,
                 base_lr=self.config.optimizer.lr)
-        if optimizer is not None:
+        self.offload_device = self.config.zero.offload_optimizer.device
+        if self.offload_device not in ("none", "cpu", "nvme"):
+            raise ValueError(f"offload_optimizer.device {self.offload_device!r}")
+        if self.offload_device != "none" and self.config.fp16.enabled:
+            raise NotImplementedError("fp16 + optimizer offload: use bf16")
+        if self.offload_device != "none":
+            # ZeRO-Offload: device step produces grads only; the update runs
+            # in the C++ CPU-Adam kernel on host master weights
+            self.tx = optax.identity()
+        elif optimizer is not None:
             # client passes a ready optax GradientTransformation
             self.tx = optimizer
             if self.config.gradient_clipping > 0:
@@ -313,9 +322,12 @@ class Engine:
         self._state_shardings = TrainState(
             step=repl, params=param_sh, opt_state=opt_sh,
             loss_scale=jax.tree_util.tree_map(lambda _: repl, ls_state))
+        if self.offload_device != "none":
+            self._init_host_optimizer(placed)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(placed))
         log_dist(f"initialized {n_params/1e6:.1f}M params | zero stage {stage} | "
-                 f"mesh {dict(self.mesh.shape)}", ranks=[0])
+                 f"offload {self.offload_device} | mesh {dict(self.mesh.shape)}",
+                 ranks=[0])
 
     def _require_state(self):
         if self._state is None:
@@ -441,6 +453,119 @@ class Engine:
         return jax.jit(step_fn, donate_argnums=(0,),
                        out_shardings=(self._state_shardings, None))
 
+    # ------------------------------------------------------------------
+    # ZeRO-Offload: host master weights + C++ CPU-Adam (reference
+    # stage_1_and_2.py cpu_offload path + csrc/adam/cpu_adam.cpp)
+    # ------------------------------------------------------------------
+    def _init_host_optimizer(self, placed_params):
+        from ..ops.adam import DeepSpeedCPUAdagrad, DeepSpeedCPUAdam
+
+        host = jax.device_get(placed_params)
+        leaves, self._host_treedef = jax.tree_util.tree_flatten(host)
+        self._host_shapes = [l.shape for l in leaves]
+        self._host_sizes = [int(np.prod(s)) for s in self._host_shapes]
+        self._host_master = np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves])
+        ocfg = self.config.optimizer
+        if ocfg.type in ("adam", "adamw"):
+            self._cpu_opt = DeepSpeedCPUAdam(
+                self._host_master.size, lr=ocfg.lr, betas=ocfg.betas,
+                eps=ocfg.eps, weight_decay=ocfg.weight_decay,
+                adamw_mode=ocfg.type == "adamw" or bool(
+                    ocfg.extra.get("adam_w_mode", True)))
+        elif ocfg.type == "adagrad":
+            self._cpu_opt = DeepSpeedCPUAdagrad(
+                self._host_master.size, lr=ocfg.lr, eps=ocfg.eps,
+                weight_decay=ocfg.weight_decay)
+        else:
+            raise NotImplementedError(
+                f"optimizer offload supports adam/adamw/adagrad, got {ocfg.type}")
+        self._swapper = None
+        if self.offload_device == "nvme":
+            from .swap_tensor import OptimizerStateSwapper
+
+            nvme_path = self.config.zero.offload_optimizer.nvme_path or "/tmp/dstpu_swap"
+            self._swapper = OptimizerStateSwapper(nvme_path)
+            # park states on NVMe between steps
+            self._swap_states_out()
+
+    def _swap_states_out(self):
+        for name in ("exp_avg", "exp_avg_sq"):
+            buf = getattr(self._cpu_opt, name, None)
+            if buf is not None:
+                self._swapper.swap_out(name, buf)
+        self._swapper.wait()
+
+    def _swap_states_in(self):
+        for name in ("exp_avg", "exp_avg_sq"):
+            buf = getattr(self._cpu_opt, name, None)
+            if buf is not None:
+                self._swapper.swap_in(name, buf)
+        self._swapper.aio.wait_all()
+
+    @functools.cached_property
+    def _compiled_grads_only(self):
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+
+        def grads_fn(state: TrainState, batch):
+            rng = jax.random.fold_in(self._base_rng, state.step)
+            if gas > 1:
+                mbs = self._split_microbatches(batch, gas)
+
+                def body(carry, mb):
+                    g_acc, l_acc, i = carry
+                    loss, grads = self._grads_of(
+                        state.params, mb, jax.random.fold_in(rng, i),
+                        jnp.float32(1.0))
+                    g_acc = self._constrain(
+                        jax.tree_util.tree_map(jnp.add, g_acc, grads),
+                        self._grad_specs)
+                    return (g_acc, l_acc + loss, i + 1), None
+
+                zeros = self._constrain(jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params),
+                    self._grad_specs)
+                (g, loss, _), _ = jax.lax.scan(
+                    body, (zeros, jnp.float32(0.0), jnp.int32(0)), mbs)
+            else:
+                loss, g = self._grads_of(state.params, batch, rng, jnp.float32(1.0))
+            g = jax.tree_util.tree_map(lambda x: x / gas, g)
+            return loss / gas, g
+
+        return jax.jit(grads_fn)
+
+    def _host_offload_train_batch(self, batch):
+        loss, grads = self._compiled_grads_only(self._state, batch)
+        flat = np.concatenate([np.asarray(l, np.float32).ravel()
+                               for l in jax.tree_util.tree_leaves(
+                                   jax.device_get(grads))])
+        if self.config.gradient_clipping > 0:
+            norm = float(np.linalg.norm(flat))
+            clip = self.config.gradient_clipping
+            if norm > clip:
+                flat *= clip / norm
+        lr = float(jax.device_get(self.lr_scheduler(self._state.step))) \
+            if callable(self.lr_scheduler) else self.config.optimizer.lr
+        if self._swapper is not None:
+            self._swap_states_in()
+        self._cpu_opt.step(self._host_master, flat, lr=lr)
+        if self._swapper is not None:
+            self._swap_states_out()
+        # re-place updated master weights with the training shardings
+        offset, leaves = 0, []
+        for shape, size in zip(self._host_shapes, self._host_sizes):
+            leaves.append(self._host_master[offset:offset + size].reshape(shape))
+            offset += size
+        host_tree = jax.tree_util.tree_unflatten(self._host_treedef, leaves)
+        param_sh = zero_lib.named_shardings(self.mesh, self._param_specs)
+        new_params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), host_tree, param_sh)
+        self._state = TrainState(step=self._state.step + 1, params=new_params,
+                                 opt_state=self._state.opt_state,
+                                 loss_scale=self._state.loss_scale)
+        return loss
+
     @functools.cached_property
     def _compiled_pipeline_step(self):
         """Train step when mesh pp>1: grad-accumulation micro-batches ARE
@@ -561,6 +686,15 @@ class Engine:
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             extra = (jnp.float32(theta),)
         batch = self._shard_batch(batch)
+        if self.offload_device != "none":
+            loss = self._host_offload_train_batch(batch)
+            self.global_steps += 1
+            self.micro_steps += self.gradient_accumulation_steps
+            self.global_samples += self.train_batch_size
+            if self.global_steps % self.config.steps_per_print == 0:
+                log_dist(f"step={self.global_steps} loss={float(jax.device_get(loss)):.4f} "
+                         f"(offload={self.offload_device})", ranks=[0])
+            return loss
         self._tput.start()
         self._state, metrics = self._compiled_train_step(self._state, batch, *extra)
         self.global_steps += 1

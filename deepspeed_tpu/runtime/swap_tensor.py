@@ -1,0 +1,107 @@
+"""NVMe tensor swapping over the native async-I/O engine.
+
+Analog of reference ``runtime/swap_tensor/`` (``AsyncPartitionedParameter
+Swapper`` ``partitioned_param_swapper.py:37``, optimizer-state swappers,
+``async_swapper.py``): optimizer-state shards park on NVMe and stream
+to/from host RAM around the optimizer step, double-buffered through the
+thread-pool aio engine (``csrc/aio.cpp``) so disk latency overlaps compute.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..ops.native import load as _load_native
+
+
+class AsyncIOHandle:
+    """Thin wrapper over the C aio engine; numpy-buffer read/write."""
+
+    def __init__(self, num_threads: int = 4):
+        self._lib = _load_native()
+        self._h = None
+        if self._lib is not None:
+            self._h = self._lib.aio_create(num_threads)
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def submit_write(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        if self._h is None:
+            with open(path, "r+b" if os.path.exists(path) else "wb") as fh:
+                fh.seek(offset)
+                fh.write(buf.tobytes())
+            return 0
+        return self._lib.aio_submit(self._h, path.encode(),
+                                    buf.ctypes.data_as(ctypes.c_void_p),
+                                    buf.nbytes, offset, 1)
+
+    def submit_read(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        if self._h is None:
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                data = fh.read(buf.nbytes)
+            buf[:] = np.frombuffer(data, dtype=buf.dtype).reshape(buf.shape)
+            return 0
+        return self._lib.aio_submit(self._h, path.encode(),
+                                    buf.ctypes.data_as(ctypes.c_void_p),
+                                    buf.nbytes, offset, 0)
+
+    def wait(self, ticket: int) -> None:
+        if self._h is None:
+            return
+        rc = self._lib.aio_wait(self._h, ticket)
+        if rc != 0:
+            raise OSError(rc, f"aio request {ticket} failed")
+
+    def wait_all(self) -> None:
+        if self._h is None:
+            return
+        rc = self._lib.aio_wait_all(self._h)
+        if rc != 0:
+            raise OSError(rc, "aio batch failed")
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.aio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D401
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class OptimizerStateSwapper:
+    """Per-buffer NVMe parking for host optimizer states
+    (``partitioned_optimizer_swapper.py`` analog)."""
+
+    def __init__(self, swap_dir: str, num_threads: int = 4):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self.aio = AsyncIOHandle(num_threads)
+        self._pending: dict[str, int] = {}
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, name.replace("/", "_") + ".swp")
+
+    def swap_out(self, name: str, buf: np.ndarray) -> None:
+        """Start writing ``buf`` to NVMe (async; caller keeps buf alive
+        until ``wait``)."""
+        self._pending[name] = self.aio.submit_write(self._path(name), buf)
+
+    def swap_in(self, name: str, buf: np.ndarray) -> None:
+        ticket = self.aio.submit_read(self._path(name), buf)
+        self.aio.wait(ticket) if self.aio.native else None
+
+    def wait(self, name: Optional[str] = None) -> None:
+        if name is None:
+            self.aio.wait_all()
+            self._pending.clear()
+        elif name in self._pending:
+            self.aio.wait(self._pending.pop(name))

@@ -1,0 +1,78 @@
+"""ZeRO-Offload: host CPU-Adam path (cpu + nvme) — reference
+``stage_1_and_2.py`` cpu_offload + ``swap_tensor`` integration tests."""
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+
+from .simple_model import SimpleModel
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _engine(offload_cfg):
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "gradient_clipping": 1.0,
+           "zero_optimization": {"stage": 2, "offload_optimizer": offload_cfg}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(), config=cfg)
+    engine.init_params()
+    return engine
+
+
+def _batch(engine, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(engine.train_batch_size, 16)).astype(np.float32)
+    return {"x": x, "y": 0.1 * x}
+
+
+def test_cpu_offload_trains():
+    engine = _engine({"device": "cpu"})
+    batch = _batch(engine)
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_cpu_offload_matches_device_adam():
+    """Host C++ Adam path ≈ on-device optax path on the same data."""
+    e_off = _engine({"device": "cpu"})
+    batch = _batch(e_off, seed=3)
+    for _ in range(3):
+        l_off = float(e_off.train_batch(batch))
+
+    mesh_mod.set_mesh(None)
+    e_dev = _engine({"device": "none"})
+    for _ in range(3):
+        l_dev = float(e_dev.train_batch(batch))
+    assert l_off == pytest.approx(l_dev, rel=5e-3)
+
+
+def test_nvme_offload_trains(tmp_path):
+    engine = _engine({"device": "nvme", "nvme_path": str(tmp_path / "swap")})
+    batch = _batch(engine, seed=1)
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # states actually parked on disk
+    import os
+
+    assert any(f.endswith(".swp") for f in os.listdir(tmp_path / "swap"))
+
+
+def test_fp16_offload_rejected():
+    with pytest.raises(NotImplementedError):
+        deepspeed_tpu.initialize(model=SimpleModel(), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "fp16": {"enabled": True},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}}})

@@ -1,0 +1,111 @@
+"""1-bit optimizer family + elasticity math — analogs of reference
+``tests/unit/test_onebit.py`` and ``test_elastic.py``."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
+from deepspeed_tpu.elasticity.elasticity import ElasticityError, get_valid_gpus
+from deepspeed_tpu.ops.onebit import compressed_all_reduce, onebit_compress
+
+from .simple_model import SimpleModel
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+# ------------------------------ elasticity ------------------------------
+
+def test_valid_gpus():
+    assert get_valid_gpus(24, [2, 3], 1, 6) == [1, 2, 3, 4, 6]
+
+
+def test_compatible_gpus_prefers_divisibility():
+    batch, gpus = get_compatible_gpus([2, 4], 100, min_gpus=1, max_gpus=8)
+    assert batch <= 100
+    assert all(any(batch % (g * mb) == 0 for mb in [2, 4]) for g in gpus)
+    assert len(gpus) >= 6
+
+
+def test_compute_elastic_config_with_world_size():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 1000,
+                          "micro_batch_sizes": [2, 4, 6], "min_gpus": 1,
+                          "max_gpus": 32, "version": 0.1}}
+    batch, gpus, micro = compute_elastic_config(cfg, world_size=8)
+    assert 8 in gpus
+    assert batch % (8 * micro) == 0
+
+
+def test_elastic_config_errors():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [7], "version": 0.2}}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg)
+
+
+# ------------------------------ 1-bit ops ------------------------------
+
+def test_onebit_compress_error_feedback():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    err = jnp.zeros_like(x)
+    comp, new_err = onebit_compress(x, err)
+    # compressed keeps only sign information at uniform magnitude
+    assert len(np.unique(np.abs(np.asarray(comp)))) == 1
+    np.testing.assert_allclose(np.asarray(comp + new_err), np.asarray(x),
+                               rtol=1e-6)
+    # error feedback: accumulated compressed stream tracks accumulated signal
+    total_comp = np.zeros(64, np.float32)
+    err = jnp.zeros_like(x)
+    for i in range(50):
+        g = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+        comp, err = onebit_compress(g, err)
+        total_comp += np.asarray(comp)
+    assert np.abs(np.asarray(err)).mean() < 5.0  # error stays bounded
+
+
+def test_compressed_all_reduce_under_shard_map():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm.mesh import build_mesh
+
+    mesh = build_mesh({"dp": 8})
+    x = jnp.arange(8.0)
+    err = jnp.zeros(8)
+
+    def body(x, e):
+        s, e2 = compressed_all_reduce(x, e, "dp")
+        return s, e2
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                   out_specs=(P("dp"), P("dp")))
+    s, e2 = fn(x, err)
+    # each rank contributed sign(+x)*|x| (scalar shards) → psum == sum
+    np.testing.assert_allclose(np.asarray(s), np.full(8, np.arange(8.0).sum()))
+
+
+@pytest.mark.parametrize("opt", ["OneBitAdam", "ZeroOneAdam", "OneBitLamb"])
+def test_onebit_optimizers_train(opt):
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_clipping": 1.0,
+           "optimizer": {"type": opt, "params": {"lr": 1e-3,
+                                                 "freeze_step": 10}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(), config=cfg)
+    engine.init_params()
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(16, 16)).astype(np.float32)}
+    batch["y"] = 0.1 * batch["x"]
+    losses = [float(engine.train_batch(batch)) for _ in range(30)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # converges through the compressed stage
