@@ -82,9 +82,13 @@ class InferenceEngine:
         if self.config.dtype is not None:
             cfg = dataclasses.replace(cfg, dtype=self.config.dtype)
         self.model_cfg = dataclasses.replace(cfg, remat=False)
+        # models name their context-length field differently
+        pos_field = "n_positions" if hasattr(cfg, "n_positions") \
+            else "max_position_embeddings"
+        self._pos_field = pos_field
         self.decode_cfg = dataclasses.replace(
             self.model_cfg, decode=True,
-            n_positions=self.config.max_tokens or cfg.n_positions)
+            **{pos_field: self.config.max_tokens or getattr(cfg, pos_field)})
         self._fwd_model = type(model)(self.model_cfg)
         self._decode_model = type(model)(self.decode_cfg)
 
@@ -118,6 +122,21 @@ class InferenceEngine:
         unboxed = jax.tree_util.tree_map(
             lambda x: getattr(x, "value", x), params,
             is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        if self.config.quant.get("enabled"):
+            # inference weight quantization (the WeightQuantization / MoQ
+            # checkpoint-quantize analog, reference weight_quantizer.py):
+            # grouped fake-quant of >=2-D weights at load
+            from ..ops.quantizer import fake_quantize
+
+            bits = int(self.config.quant.get("bits",
+                       self.config.quant.get("qtype", 8)))
+            groups = int(self.config.quant.get("groups", 64))
+            unboxed = jax.tree_util.tree_map(
+                lambda x: np.asarray(fake_quantize(
+                    jnp.asarray(x, jnp.float32), bits,
+                    groups if np.size(x) % groups == 0 else 1))
+                if np.ndim(x) >= 2 else x, unboxed)
+            log_dist(f"quantized inference weights to {bits} bits", ranks=[0])
         self.params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x), s), unboxed, shardings)
         n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
@@ -194,7 +213,7 @@ class InferenceEngine:
             raise RuntimeError("no parameters loaded; pass params=/checkpoint=")
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, S = input_ids.shape
-        limit = self.decode_cfg.n_positions
+        limit = getattr(self.decode_cfg, self._pos_field)
         if S + max_new_tokens > limit:
             raise ValueError(f"prompt({S}) + max_new_tokens({max_new_tokens}) "
                              f"exceeds cache length {limit}")

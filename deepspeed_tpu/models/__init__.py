@@ -1,2 +1,5 @@
 from .common import TP_RULES, cross_entropy_loss, shift_labels  # noqa: F401
 from .gpt2 import GPT2Config, GPT2LMHeadModel, gpt2_config  # noqa: F401
+from .bert import BertConfig, BertForPreTraining, BertModel, bert_config  # noqa: F401
+from .gptneox import GPTNeoXConfig, GPTNeoXForCausalLM, gptneox_config  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, llama_config  # noqa: F401

@@ -1,0 +1,255 @@
+"""GPT-NeoX model family, TPU-native.
+
+Parity target: the reference's GPT-NeoX injection policy
+(``module_inject/replace_policy.py:324`` ``GPTNEOXLayerPolicy``) and
+BASELINE.json config #4 ("GPT-NeoX MoE").  Architecture: rotary attention
+(partial, ``rotary_pct``), PARALLEL residual (x + attn(ln1 x) + mlp(ln2 x)),
+untied ``embed_out`` head.  Same logical-axis vocabulary, scan/remat/MoE/
+decode support as GPT-2.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..ops.rotary import apply_rotary_pos_emb
+from .common import ModelOutput, cross_entropy_loss, shift_labels
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    max_position_embeddings: int = 2048
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"
+    vocab_pad_multiple: int = 128
+    decode: bool = False
+    moe: Optional[Any] = None
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+
+PRESETS = {
+    "neox-tiny": dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=128),
+    "pythia-1b": dict(hidden_size=2048, num_hidden_layers=16,
+                      num_attention_heads=8, intermediate_size=8192),
+    "neox-20b": dict(hidden_size=6144, num_hidden_layers=44,
+                     num_attention_heads=64, intermediate_size=24576),
+}
+
+
+def gptneox_config(preset: str = "neox-tiny", **overrides) -> GPTNeoXConfig:
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; valid: {sorted(PRESETS)}")
+    return GPTNeoXConfig(**{**PRESETS[preset], **overrides})
+
+
+def _dense(x, features, names, *, cfg, name, module):
+    kernel = module.param(
+        name + "_kernel",
+        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+        (x.shape[-1], features), cfg.param_dtype)
+    bias = module.param(name + "_bias",
+                        nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+                        (features,), cfg.param_dtype)
+    return jnp.dot(x, kernel.astype(cfg.dtype)) + bias.astype(cfg.dtype)
+
+
+class NeoXLayerNorm(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_eps)
+        scale = self.param("scale", nn.with_partitioning(nn.initializers.ones,
+                                                         ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros,
+                                                       ("embed",)),
+                          (x.shape[-1],), self.cfg.param_dtype)
+        return (y * scale + bias).astype(dtype)
+
+
+class NeoXAttention(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, position_ids, attn_mask):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        # HF NeoX packs qkv per-head interleaved: (H, 3, D); we store a
+        # fused (E, 3E) kernel in the same interleaved order (the
+        # conversion policy handles the permutation)
+        qkv = _dense(x, 3 * E, ("embed", "qkv"), cfg=cfg, name="qkv", module=self)
+        qkv = qkv.reshape(B, S, H, 3, D)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        q, k = apply_rotary_pos_emb(q, k, position_ids, cfg.rotary_dim,
+                                    cfg.rotary_emb_base)
+        if cfg.decode:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+            idx.value = cur + S
+            q_pos = cur + jnp.arange(S)[:, None]
+            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
+            mask = (k_pos <= q_pos)[None, None, :, :]
+            y = dot_product_attention(q, ck.value, cv.value, causal=False,
+                                      mask=mask, impl="jnp")
+        else:
+            y = dot_product_attention(q, k, v, causal=True, mask=attn_mask,
+                                      impl=cfg.attn_impl)
+        y = y.reshape(B, S, E)
+        return _dense(y, E, ("heads", "embed"), cfg=cfg, name="dense", module=self)
+
+
+class NeoXBlock(nn.Module):
+    cfg: GPTNeoXConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, inputs):
+        position_ids, attn_mask = inputs
+        cfg = self.cfg
+        attn = NeoXAttention(cfg, name="attention")(
+            NeoXLayerNorm(cfg, name="input_ln")(x), position_ids, attn_mask)
+        h_in = NeoXLayerNorm(cfg, name="post_attention_ln")(
+            x if cfg.use_parallel_residual else x + attn)
+        if cfg.moe is not None:
+            from ..parallel.moe import MoELayer
+
+            mlp, aux = MoELayer(cfg.moe, model_dim=cfg.hidden_size,
+                                hidden_dim=cfg.intermediate_size,
+                                dtype=cfg.dtype, name="moe")(
+                h_in, train=not self.deterministic)
+        else:
+            h = _dense(h_in, cfg.intermediate_size, ("embed", "mlp"), cfg=cfg,
+                       name="dense_h_to_4h", module=self)
+            h = nn.gelu(h, approximate=False)  # HF NeoX uses exact gelu
+            mlp = _dense(h, cfg.hidden_size, ("mlp", "embed"), cfg=cfg,
+                         name="dense_4h_to_h", module=self)
+            aux = jnp.zeros((), jnp.float32)
+        if cfg.use_parallel_residual:
+            x = x + attn + mlp
+        else:
+            x = (x + attn) + mlp
+        return x, aux
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 labels=None, deterministic: bool = True, shift: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        embed_in = self.param("embed_in", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")),
+            (cfg.padded_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        if position_ids is None:
+            if cfg.decode:
+                raise ValueError("decode mode requires explicit position_ids")
+            position_ids = jnp.arange(S)[None, :]
+        h = embed_in.astype(cfg.dtype)[input_ids]
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        block_cls = NeoXBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                NeoXBlock, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                prevent_cse=False)
+        if cfg.scan_layers:
+            stack = nn.scan(block_cls,
+                            variable_axes={"params": 0, "cache": 0},
+                            split_rngs={"params": True, "dropout": True,
+                                        "gating": True, "pld": True},
+                            length=cfg.num_hidden_layers,
+                            in_axes=nn.broadcast,
+                            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, layer_aux = stack(cfg, deterministic, name="layers")(
+                h, (position_ids, mask))
+            aux_loss = layer_aux.sum()
+        else:
+            aux_loss = jnp.zeros((), jnp.float32)
+            for i in range(cfg.num_hidden_layers):
+                h, aux = block_cls(cfg, deterministic, name=f"layers_{i}")(
+                    h, (position_ids, mask))
+                aux_loss = aux_loss + aux
+
+        h = NeoXLayerNorm(cfg, name="final_ln")(h)
+        embed_out = self.param("embed_out", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.padded_vocab_size), cfg.param_dtype)
+        logits = jnp.dot(h, embed_out.astype(cfg.dtype))
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+            logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+
+        out = ModelOutput(logits=logits)
+        if cfg.moe is not None:
+            out["aux_loss"] = aux_loss
+        if labels is not None:
+            tgt = shift_labels(labels) if shift else labels
+            loss = cross_entropy_loss(logits, tgt)
+            if cfg.moe is not None:
+                loss = loss + aux_loss
+            out["loss"] = loss
+        return out
+
+    def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
+        S = seq_len or min(self.cfg.max_position_embeddings, 128)
+        ids = jnp.zeros((batch_size, S), jnp.int32)
+        return {"input_ids": ids, "labels": ids}
+
+    def flops_per_token(self) -> float:
+        cfg = self.cfg
+        E, L = cfg.hidden_size, cfg.num_hidden_layers
+        n = (2 * cfg.padded_vocab_size * E
+             + L * (4 * E * E + 2 * E * cfg.intermediate_size))
+        return 6.0 * n + 12 * L * E * cfg.max_position_embeddings

@@ -1,0 +1,231 @@
+"""LLaMA model family, TPU-native.
+
+Beyond the reference's 2022 policy list — added because a modern user of
+the framework expects the dominant open-model family.  Architecture:
+RMSNorm, SwiGLU MLP, full rotary, grouped-query attention
+(``num_key_value_heads``), untied LM head.  Shares the logical-axis
+vocabulary, scan/remat/decode support of the other zoo families.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..ops.rotary import apply_rotary_pos_emb
+from .common import ModelOutput, cross_entropy_loss, shift_labels
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 2048
+    hidden_size: int = 2048
+    num_hidden_layers: int = 16
+    num_attention_heads: int = 16
+    num_key_value_heads: Optional[int] = None   # None → MHA
+    intermediate_size: int = 5632
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"
+    vocab_pad_multiple: int = 128
+    decode: bool = False
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+PRESETS = {
+    "llama-tiny": dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       intermediate_size=128, max_position_embeddings=128),
+    "llama-1b": dict(hidden_size=2048, num_hidden_layers=22,
+                     num_attention_heads=32, num_key_value_heads=4,
+                     intermediate_size=8192),
+    "llama-7b": dict(hidden_size=4096, num_hidden_layers=32,
+                     num_attention_heads=32, intermediate_size=11008),
+}
+
+
+def llama_config(preset: str = "llama-tiny", **overrides) -> LlamaConfig:
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; valid: {sorted(PRESETS)}")
+    return LlamaConfig(**{**PRESETS[preset], **overrides})
+
+
+def _dense(x, features, names, *, cfg, name, module):
+    kernel = module.param(
+        name + "_kernel",
+        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+        (x.shape[-1], features), cfg.param_dtype)
+    return jnp.dot(x, kernel.astype(cfg.dtype))
+
+
+class RMSNorm(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf ** 2, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + self.cfg.rms_norm_eps)
+        scale = self.param("scale", nn.with_partitioning(nn.initializers.ones,
+                                                         ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        return (y * scale).astype(dtype)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, position_ids, attn_mask):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, KV, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        q = _dense(x, H * D, ("embed", "qkv"), cfg=cfg, name="q_proj",
+                   module=self).reshape(B, S, H, D)
+        k = _dense(x, KV * D, ("embed", "kv"), cfg=cfg, name="k_proj",
+                   module=self).reshape(B, S, KV, D)
+        v = _dense(x, KV * D, ("embed", "kv"), cfg=cfg, name="v_proj",
+                   module=self).reshape(B, S, KV, D)
+        q, k = apply_rotary_pos_emb(q, k, position_ids, rotary_dim=D,
+                                    theta=cfg.rope_theta)
+        if cfg.decode:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, cfg.max_position_embeddings, KV, D), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, cfg.max_position_embeddings, KV, D), cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+            idx.value = cur + S
+            k_full, v_full = ck.value, cv.value
+            q_pos = cur + jnp.arange(S)[:, None]
+            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
+            mask = (k_pos <= q_pos)[None, None, :, :]
+            causal = False
+        else:
+            k_full, v_full, mask, causal = k, v, attn_mask, True
+        if KV != H:  # GQA: repeat kv heads
+            rep = H // KV
+            k_full = jnp.repeat(k_full, rep, axis=2)
+            v_full = jnp.repeat(v_full, rep, axis=2)
+        y = dot_product_attention(q, k_full, v_full, causal=causal, mask=mask,
+                                  impl=cfg.attn_impl if not cfg.decode else "jnp")
+        y = y.reshape(B, S, H * D)
+        return _dense(y, E, ("heads", "embed"), cfg=cfg, name="o_proj", module=self)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, inputs):
+        position_ids, attn_mask = inputs
+        cfg = self.cfg
+        x = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg, name="input_norm")(x), position_ids, attn_mask)
+        h = RMSNorm(cfg, name="post_attention_norm")(x)
+        gate = _dense(h, cfg.intermediate_size, ("embed", "mlp"), cfg=cfg,
+                      name="gate_proj", module=self)
+        up = _dense(h, cfg.intermediate_size, ("embed", "mlp"), cfg=cfg,
+                    name="up_proj", module=self)
+        ff = _dense(nn.silu(gate) * up, cfg.hidden_size, ("mlp", "embed"),
+                    cfg=cfg, name="down_proj", module=self)
+        return x + ff, None
+
+
+class LlamaForCausalLM(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 labels=None, deterministic: bool = True, shift: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        embed = self.param("embed_tokens", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")),
+            (cfg.padded_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        if position_ids is None:
+            if cfg.decode:
+                raise ValueError("decode mode requires explicit position_ids")
+            position_ids = jnp.arange(S)[None, :]
+        h = embed.astype(cfg.dtype)[input_ids]
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        block_cls = LlamaBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                LlamaBlock, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                prevent_cse=False)
+        if cfg.scan_layers:
+            stack = nn.scan(block_cls,
+                            variable_axes={"params": 0, "cache": 0},
+                            split_rngs={"params": True, "dropout": True,
+                                        "gating": True, "pld": True},
+                            length=cfg.num_hidden_layers,
+                            in_axes=nn.broadcast,
+                            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, _ = stack(cfg, deterministic, name="layers")(h, (position_ids, mask))
+        else:
+            for i in range(cfg.num_hidden_layers):
+                h, _ = block_cls(cfg, deterministic, name=f"layers_{i}")(
+                    h, (position_ids, mask))
+
+        h = RMSNorm(cfg, name="norm")(h)
+        lm_head = self.param("lm_head", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("embed", "vocab")),
+            (cfg.hidden_size, cfg.padded_vocab_size), cfg.param_dtype)
+        logits = jnp.dot(h, lm_head.astype(cfg.dtype))
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+            logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            tgt = shift_labels(labels) if shift else labels
+            out["loss"] = cross_entropy_loss(logits, tgt)
+        return out
+
+    def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
+        S = seq_len or min(self.cfg.max_position_embeddings, 128)
+        ids = jnp.zeros((batch_size, S), jnp.int32)
+        return {"input_ids": ids, "labels": ids}
+
+    def flops_per_token(self) -> float:
+        cfg = self.cfg
+        E, L = cfg.hidden_size, cfg.num_hidden_layers
+        D = cfg.head_dim
+        n = (2 * cfg.padded_vocab_size * E
+             + L * (E * E + 2 * E * cfg.kv_heads * D + E * E
+                    + 3 * E * cfg.intermediate_size))
+        return 6.0 * n + 12 * L * E * cfg.max_position_embeddings

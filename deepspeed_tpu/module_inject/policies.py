@@ -120,6 +120,138 @@ def _tree_f32(x):
     return np.asarray(x, dtype=np.float32)
 
 
+@register_policy("GPTNeoX")
+def convert_hf_gptneox(hf_model, dtype=None):
+    """HF GPT-NeoX → zoo ``GPTNeoXForCausalLM`` (policy analog of
+    ``replace_policy.py:324`` ``GPTNEOXLayerPolicy``).  HF's fused
+    query_key_value Linear is already head-interleaved (H, 3, D) — the same
+    layout the zoo kernel expects, so conversion is transpose+stack."""
+    import jax.numpy as jnp
+
+    from ..models.gptneox import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hc = hf_model.config
+    cfg = GPTNeoXConfig(
+        vocab_size=hc.vocab_size,
+        max_position_embeddings=hc.max_position_embeddings,
+        hidden_size=hc.hidden_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        intermediate_size=hc.intermediate_size,
+        rotary_pct=hc.rotary_pct,
+        rotary_emb_base=getattr(hc, "rotary_emb_base", 10000.0),
+        layer_norm_eps=hc.layer_norm_eps,
+        use_parallel_residual=hc.use_parallel_residual,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        scan_layers=True,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = cfg.num_hidden_layers
+
+    def lin_t(fmt):
+        return np.stack([sd[fmt.format(i)].T for i in range(L)])
+
+    def vec(fmt):
+        return np.stack([sd[fmt.format(i)] for i in range(L)])
+
+    def pad_vocab(w):
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size,
+                            w.shape[1]), np.float32)
+            return np.concatenate([w.astype(np.float32), pad], axis=0)
+        return w
+
+    params = {
+        "embed_in": pad_vocab(sd["gpt_neox.embed_in.weight"]),
+        "embed_out": pad_vocab(sd["embed_out.weight"]).T,
+        "final_ln": {"scale": sd["gpt_neox.final_layer_norm.weight"],
+                     "bias": sd["gpt_neox.final_layer_norm.bias"]},
+        "layers": {
+            "input_ln": {"scale": vec("gpt_neox.layers.{}.input_layernorm.weight"),
+                         "bias": vec("gpt_neox.layers.{}.input_layernorm.bias")},
+            "post_attention_ln": {
+                "scale": vec("gpt_neox.layers.{}.post_attention_layernorm.weight"),
+                "bias": vec("gpt_neox.layers.{}.post_attention_layernorm.bias")},
+            "attention": {
+                "qkv_kernel": lin_t("gpt_neox.layers.{}.attention.query_key_value.weight"),
+                "qkv_bias": vec("gpt_neox.layers.{}.attention.query_key_value.bias"),
+                "dense_kernel": lin_t("gpt_neox.layers.{}.attention.dense.weight"),
+                "dense_bias": vec("gpt_neox.layers.{}.attention.dense.bias"),
+            },
+            "dense_h_to_4h_kernel": lin_t("gpt_neox.layers.{}.mlp.dense_h_to_4h.weight"),
+            "dense_h_to_4h_bias": vec("gpt_neox.layers.{}.mlp.dense_h_to_4h.bias"),
+            "dense_4h_to_h_kernel": lin_t("gpt_neox.layers.{}.mlp.dense_4h_to_h.weight"),
+            "dense_4h_to_h_bias": vec("gpt_neox.layers.{}.mlp.dense_4h_to_h.bias"),
+        },
+    }
+    logger.info(f"converted HF GPT-NeoX ({L}L, {cfg.hidden_size}d) to zoo params")
+    return GPTNeoXForCausalLM(cfg), _tree_f32(params)
+
+
+@register_policy("Llama")
+def convert_hf_llama(hf_model, dtype=None):
+    """HF LLaMA → zoo ``LlamaForCausalLM`` (modern-family extension of the
+    policy registry)."""
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    hc = hf_model.config
+    cfg = LlamaConfig(
+        vocab_size=hc.vocab_size,
+        max_position_embeddings=hc.max_position_embeddings,
+        hidden_size=hc.hidden_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        num_key_value_heads=getattr(hc, "num_key_value_heads", None),
+        intermediate_size=hc.intermediate_size,
+        rms_norm_eps=hc.rms_norm_eps,
+        rope_theta=getattr(hc, "rope_theta", 10000.0),
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        scan_layers=True,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = cfg.num_hidden_layers
+
+    def lin_t(fmt):
+        return np.stack([sd[fmt.format(i)].T for i in range(L)])
+
+    def vec(fmt):
+        return np.stack([sd[fmt.format(i)] for i in range(L)])
+
+    def pad_vocab(w):
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size,
+                            w.shape[1]), np.float32)
+            return np.concatenate([w.astype(np.float32), pad], axis=0)
+        return w
+
+    lm_head = sd.get("lm_head.weight")
+    if lm_head is None:  # tied embeddings
+        lm_head = sd["model.embed_tokens.weight"]
+    params = {
+        "embed_tokens": pad_vocab(sd["model.embed_tokens.weight"]),
+        "lm_head": pad_vocab(lm_head).T,
+        "norm": {"scale": sd["model.norm.weight"]},
+        "layers": {
+            "input_norm": {"scale": vec("model.layers.{}.input_layernorm.weight")},
+            "post_attention_norm": {
+                "scale": vec("model.layers.{}.post_attention_layernorm.weight")},
+            "self_attn": {
+                "q_proj_kernel": lin_t("model.layers.{}.self_attn.q_proj.weight"),
+                "k_proj_kernel": lin_t("model.layers.{}.self_attn.k_proj.weight"),
+                "v_proj_kernel": lin_t("model.layers.{}.self_attn.v_proj.weight"),
+                "o_proj_kernel": lin_t("model.layers.{}.self_attn.o_proj.weight"),
+            },
+            "gate_proj_kernel": lin_t("model.layers.{}.mlp.gate_proj.weight"),
+            "up_proj_kernel": lin_t("model.layers.{}.mlp.up_proj.weight"),
+            "down_proj_kernel": lin_t("model.layers.{}.mlp.down_proj.weight"),
+        },
+    }
+    logger.info(f"converted HF LLaMA ({L}L, {cfg.hidden_size}d) to zoo params")
+    return LlamaForCausalLM(cfg), _tree_f32(params)
+
+
 @register_policy("Bert")
 def convert_hf_bert(hf_model, dtype=None):
     """HF BERT (BertForPreTraining/BertForMaskedLM/BertModel) → zoo BERT

@@ -1,0 +1,52 @@
+"""Rotary position embeddings.
+
+Kernel-parity analog of reference
+``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu`` (378 LoC CUDA):
+rotate the leading ``rotary_dim`` channels of q/k by position-dependent
+angles.  One fused XLA computation; supports GPT-NeoX style (half-split)
+rotation and partial rotary (``rotary_pct``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rotary_angles(positions: jax.Array, rotary_dim: int,
+                  theta: float = 10000.0):
+    """cos/sin tables for integer positions; shapes (..., rotary_dim/2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32)
+                                / rotary_dim))
+    ang = positions[..., None].astype(jnp.float32) * inv_freq  # (..., S, rd/2)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 rotary_dim: Optional[int] = None) -> jax.Array:
+    """Rotate ``x`` (B, S, H, D) half-split style (GPT-NeoX/LLaMA):
+    ``x1' = x1·cos − x2·sin``, ``x2' = x2·cos + x1·sin`` over the first
+    ``rotary_dim`` channels; the rest pass through."""
+    D = x.shape[-1]
+    rd = D if rotary_dim is None else rotary_dim
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    half = rd // 2
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    cos = cos[:, :, None, :].astype(x.dtype)   # (B, S, 1, rd/2)
+    sin = sin[:, :, None, :].astype(x.dtype)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    out = jnp.concatenate([out1, out2], axis=-1)
+    if rd < D:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
+
+
+def apply_rotary_pos_emb(q: jax.Array, k: jax.Array, positions: jax.Array,
+                         rotary_dim: Optional[int] = None,
+                         theta: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+    """q/k (B, S, H, D); positions (B, S) int."""
+    rd = q.shape[-1] if rotary_dim is None else rotary_dim
+    cos, sin = rotary_angles(positions, rd, theta)
+    return (apply_rotary(q, cos, sin, rd), apply_rotary(k, cos, sin, rd))

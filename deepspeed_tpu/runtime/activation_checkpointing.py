@@ -1,0 +1,96 @@
+"""Activation checkpointing (rematerialization).
+
+Analog of reference ``runtime/activation_checkpointing/checkpointing.py``
+(917 LoC): ``CheckpointFunction`` :493 re-runs forward in backward,
+``partition_activations`` :367 shards saved activations across MP ranks,
+CPU checkpointing moves them to host, and ``CudaRNGStatesTracker`` :122
+replays dropout RNG so TP ranks agree.
+
+TPU-native mapping — most of that machinery is a ``jax.checkpoint``
+POLICY:
+
+- checkpointing      → ``jax.checkpoint`` (remat) on the layer stack
+                       (zoo models: ``remat=True`` + ``remat_policy``)
+- partition_activations → saved residuals inherit the activation sharding
+                       (seq/batch dims stay sharded on the mesh — XLA never
+                       gathers them), i.e. partitioning is the default
+- contiguous_memory  → XLA's allocator owns layout; no-op knob
+- cpu_checkpointing  → ``save_and_offload_only_these_names`` /
+                       offload policies (gated on jax version)
+- RNG tracker        → unnecessary by construction: flax threads explicit
+                       PRNG keys, and remat replays the SAME keys, so
+                       dropout is bit-identical between forward and
+                       recompute on every TP rank.
+
+This module provides the reference-shaped functional API for user code
+that calls ``checkpoint(fn, *args)`` directly (Megatron-style models).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from .config import ActivationCheckpointingConfig
+from ..utils.logging import logger
+
+_config = ActivationCheckpointingConfig()
+
+
+def configure(deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, checkpoint_in_cpu=None,
+              synchronize=None, profile=None, **_):
+    """Reference ``configure`` (:825) — records the policy knobs."""
+    global _config
+    if deepspeed_config is not None:
+        _config = getattr(deepspeed_config, "activation_checkpointing", _config)
+    if partition_activations is not None:
+        _config.partition_activations = bool(partition_activations)
+    if checkpoint_in_cpu is not None:
+        _config.cpu_checkpointing = bool(checkpoint_in_cpu)
+    if contiguous_checkpointing:
+        logger.warning("contiguous_memory_optimization is a no-op on TPU "
+                       "(XLA owns allocation)")
+
+
+def _policy():
+    name = _config.policy if _config.enabled else "nothing_saveable"
+    pol = getattr(jax.checkpoint_policies, name, None)
+    if pol is None:
+        raise ValueError(f"unknown remat policy {name!r}; see "
+                         "jax.checkpoint_policies")
+    return pol
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Reference ``CheckpointFunction.apply`` analog: run ``function`` under
+    remat — activations are recomputed in backward per the configured
+    policy."""
+    return jax.checkpoint(function, policy=_policy())(*args)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    return jax.checkpoint(function, policy=_policy())
+
+
+# RNG tracker API surface for Megatron-style callers; a no-op because flax
+# PRNG keys make remat bit-deterministic (see module docstring).
+class CudaRNGStatesTracker:
+    def add(self, name, seed):  # noqa: D401
+        pass
+
+    def fork(self, name="model-parallel-rng"):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+_RNG_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> CudaRNGStatesTracker:
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    pass

@@ -287,18 +287,7 @@ class Engine:
                 return self.model.init(r, **fake)
             boxed = jax.eval_shape(_init, rng)["params"]
 
-        stage = self.zero_stage
-        if self.pp_size > 1:
-            # pipeline stages own their slice of the stacked layer dim
-            self._partition_rules = dict(self._partition_rules, layers="pp")
-        self._param_specs = zero_lib.param_partition_specs(
-            boxed, self.mesh, stage, rules=self._partition_rules)
-        stage3_like = zero_lib.shard_like_stage3(boxed, self.mesh,
-                                                 rules=self._partition_rules)
-        self._grad_specs = stage3_like if stage >= 2 else self._param_specs
-        opt_like = stage3_like if stage >= 1 else self._param_specs
-        self._opt_specs = zero_lib.opt_state_specs(self.tx, boxed, opt_like)
-
+        self._build_specs(boxed)
         param_sh = zero_lib.named_shardings(self.mesh, self._param_specs)
         opt_sh = zero_lib.named_shardings(self.mesh, self._opt_specs)
         repl = NamedSharding(self.mesh, P())
@@ -325,9 +314,64 @@ class Engine:
         if self.offload_device != "none":
             self._init_host_optimizer(placed)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(placed))
-        log_dist(f"initialized {n_params/1e6:.1f}M params | zero stage {stage} | "
-                 f"offload {self.offload_device} | mesh {dict(self.mesh.shape)}",
-                 ranks=[0])
+        log_dist(f"initialized {n_params/1e6:.1f}M params | zero stage "
+                 f"{self.zero_stage} | offload {self.offload_device} | "
+                 f"mesh {dict(self.mesh.shape)}", ranks=[0])
+
+    def _build_specs(self, boxed_abstract_params) -> None:
+        """Sharding specs for params/grads/opt state from the ZeRO stage +
+        TP rules (no device arrays touched)."""
+        stage = self.zero_stage
+        if self.pp_size > 1:
+            # pipeline stages own their slice of the stacked layer dim
+            self._partition_rules = dict(self._partition_rules, layers="pp")
+        self._param_specs = zero_lib.param_partition_specs(
+            boxed_abstract_params, self.mesh, stage, rules=self._partition_rules)
+        stage3_like = zero_lib.shard_like_stage3(boxed_abstract_params, self.mesh,
+                                                 rules=self._partition_rules)
+        self._grad_specs = stage3_like if stage >= 2 else self._param_specs
+        opt_like = stage3_like if stage >= 1 else self._param_specs
+        self._opt_specs = zero_lib.opt_state_specs(
+            self.tx, boxed_abstract_params, opt_like)
+
+    def abstract_state(self, example_batch=None) -> "TrainState":
+        """Abstract (ShapeDtypeStruct + sharding) TrainState — compile-time
+        analysis without materializing a single parameter (used by the
+        autotuner's memory probing)."""
+        if example_batch is None:
+            example_batch = self.model.dummy_inputs(
+                batch_size=max(self.train_micro_batch_size_per_gpu * self.dp_world, 1))
+        rng = jax.random.PRNGKey(0)
+        example_sds = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), example_batch)
+
+        def _init(r):
+            fake = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), example_sds)
+            return self.model.init(r, **fake)
+
+        boxed = jax.eval_shape(_init, rng)["params"]
+        self._build_specs(boxed)
+        param_sh = zero_lib.named_shardings(self.mesh, self._param_specs)
+        opt_sh = zero_lib.named_shardings(self.mesh, self._opt_specs)
+        repl = NamedSharding(self.mesh, P())
+        unboxed = _unbox(boxed)
+        a_params = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            unboxed, param_sh)
+        a_opt = jax.eval_shape(self.tx.init, unboxed)
+        a_opt = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            a_opt, opt_sh)
+        ls = jax.eval_shape(lambda: precision.init_loss_scale(self.config.fp16))
+        ls = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl), ls)
+        self._state_shardings = TrainState(
+            step=repl, params=param_sh, opt_state=opt_sh,
+            loss_scale=jax.tree_util.tree_map(lambda _: repl, ls))
+        return TrainState(
+            step=jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+            params=a_params, opt_state=a_opt, loss_scale=ls)
 
     def _require_state(self):
         if self._state is None:
