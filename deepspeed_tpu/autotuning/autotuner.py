@@ -114,9 +114,7 @@ class Autotuner:
         try:
             engine = self._trial_engine(stage, micro, remat)
             batch = engine.model.dummy_inputs(
-                batch_size=engine.train_batch_size // engine.gradient_accumulation_steps
-                * engine.gradient_accumulation_steps or engine.train_batch_size,
-                seq_len=self.seq_len)
+                batch_size=engine.train_batch_size, seq_len=self.seq_len)
             abstract = engine.abstract_state(batch)
             a_batch = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), batch)
@@ -126,22 +124,23 @@ class Autotuner:
             if isinstance(costs, list):
                 costs = costs[0] if costs else {}
             costs = dict(costs or {})
+            # memory_analysis/cost_analysis report the PER-DEVICE
+            # (post-SPMD-partitioning) program — compare against one
+            # chip's HBM directly, no further division
             mem = compiled.memory_analysis()
             peak = float(getattr(mem, "temp_size_in_bytes", 0)
                          + getattr(mem, "argument_size_in_bytes", 0)
                          + getattr(mem, "output_size_in_bytes", 0)) \
                 if mem is not None else float("nan")
-            n_dev = max(engine.n_devices, 1)
             result.flops = float(costs.get("flops", 0.0))
             result.bytes_accessed = float(costs.get("bytes accessed", 0.0))
             result.peak_memory_bytes = peak
-            result.fits = not np.isnan(peak) and peak / n_dev <= self.hbm_budget \
-                if peak == peak else True
+            result.fits = np.isnan(peak) or peak <= self.hbm_budget
             spec = _chip_spec()
             # roofline per device
             result.est_step_time = max(
-                result.flops / n_dev / spec["flops"],
-                result.bytes_accessed / n_dev / spec["bw"])
+                result.flops / spec["flops"],
+                result.bytes_accessed / spec["bw"])
         except Exception as e:  # noqa: BLE001 — a failing candidate is data
             result.error = f"{type(e).__name__}: {e}"
         return result
@@ -162,22 +161,32 @@ class Autotuner:
             raise RuntimeError(
                 "no candidate configuration fits in memory; errors: "
                 + "; ".join(str(r.error) for r in self.results[:3]))
-        # prefer highest samples/sec: batch/est_time
-        best = max(viable, key=lambda r:
-                   r.config_overrides["train_micro_batch_size_per_gpu"]
-                   / r.est_step_time)
         if measure_top_k:
             best = self._measure_and_pick(viable, measure_top_k)
+        else:
+            # prefer highest samples/sec: batch/est_time
+            best = max(viable, key=lambda r:
+                       r.config_overrides["train_micro_batch_size_per_gpu"]
+                       / r.est_step_time)
         cfg = dict(self.base_config)
         cfg["zero_optimization"] = dict(cfg.get("zero_optimization", {}),
                                         stage=best.config_overrides["zero_optimization.stage"])
         cfg["train_micro_batch_size_per_gpu"] = \
             best.config_overrides["train_micro_batch_size_per_gpu"]
+        if best.config_overrides["remat"]:
+            # the winning trial was measured WITH remat — carry it into the
+            # returned config (engine applies it to the model's layer stack)
+            cfg["activation_checkpointing"] = dict(
+                cfg.get("activation_checkpointing", {}), enabled=True)
         cfg["autotuned"] = best.config_overrides
         return cfg
 
     def _measure_and_pick(self, viable, k):
-        ranked = sorted(viable, key=lambda r: r.est_step_time)[:k]
+        def est_throughput(r):
+            return (r.config_overrides["train_micro_batch_size_per_gpu"]
+                    / r.est_step_time)
+
+        ranked = sorted(viable, key=est_throughput, reverse=True)[:k]
         for r in ranked:
             try:
                 o = r.config_overrides
@@ -199,8 +208,12 @@ class Autotuner:
             except Exception as e:  # noqa: BLE001
                 r.error = str(e)
         measured = [r for r in ranked if r.measured_step_time is not None]
-        return min(measured or ranked, key=lambda r:
-                   r.measured_step_time or r.est_step_time)
+        if not measured:
+            return max(ranked, key=est_throughput)
+        # samples/sec on the measured wall time, same objective as tune()
+        return max(measured, key=lambda r:
+                   r.config_overrides["train_micro_batch_size_per_gpu"]
+                   / r.measured_step_time)
 
 
 def autotune(model, base_config: dict, **kwargs) -> dict:

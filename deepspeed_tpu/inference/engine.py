@@ -35,6 +35,7 @@ from ..comm.mesh import MeshConfig, build_mesh, set_mesh
 from ..models.common import TP_RULES
 from ..parallel import zero as zero_lib
 from ..utils import log_dist
+from ..utils.logging import logger
 
 
 @dataclasses.dataclass
@@ -131,11 +132,20 @@ class InferenceEngine:
             bits = int(self.config.quant.get("bits",
                        self.config.quant.get("qtype", 8)))
             groups = int(self.config.quant.get("groups", 64))
-            unboxed = jax.tree_util.tree_map(
-                lambda x: np.asarray(fake_quantize(
-                    jnp.asarray(x, jnp.float32), bits,
-                    groups if np.size(x) % groups == 0 else 1))
-                if np.ndim(x) >= 2 else x, unboxed)
+            def _quant_leaf(path, x):
+                if np.ndim(x) < 2:
+                    return x
+                g = groups
+                if np.size(x) % groups != 0:
+                    g = 1
+                    logger.warning(
+                        f"quantizing {jax.tree_util.keystr(path)} with ONE "
+                        f"group (size {np.size(x)} not divisible by "
+                        f"{groups}) — coarser than requested")
+                return np.asarray(fake_quantize(
+                    jnp.asarray(x, jnp.float32), bits, g))
+
+            unboxed = jax.tree_util.tree_map_with_path(_quant_leaf, unboxed)
             log_dist(f"quantized inference weights to {bits} bits", ranks=[0])
         self.params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x), s), unboxed, shardings)

@@ -3,3 +3,5 @@ from .gpt2 import GPT2Config, GPT2LMHeadModel, gpt2_config  # noqa: F401
 from .bert import BertConfig, BertForPreTraining, BertModel, bert_config  # noqa: F401
 from .gptneox import GPTNeoXConfig, GPTNeoXForCausalLM, gptneox_config  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, llama_config  # noqa: F401
+from .gptneo import GPTNeoConfig, GPTNeoForCausalLM, gptneo_config  # noqa: F401
+from .gptj import GPTJConfig, GPTJForCausalLM, gptj_config  # noqa: F401

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 TP_RULES = {
     "vocab": "tp",
     "qkv": "tp",
+    "kv": "tp",            # GQA K/V projection output (LLaMA)
     "heads": "tp",
     "mlp": "tp",
     "experts": "ep",       # expert dim of MoE weights

@@ -1,0 +1,228 @@
+"""GPT-J model family, TPU-native.
+
+Parity target: the reference's GPT-J injection policy
+(``module_inject/replace_policy.py:158`` ``HFGPTJLayerPolicy``).
+Architecture: interleaved ("rotate every two") rotary embeddings on the
+leading ``rotary_dim`` channels, PARALLEL residual where attention and MLP
+both read the SAME ``ln_1`` output (x + attn(ln x) + mlp(ln x)), bias-free
+q/k/v/out projections, and an untied lm_head WITH bias.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..ops.rotary import apply_rotary_pos_emb
+from .common import ModelOutput, cross_entropy_loss, shift_labels
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    max_position_embeddings: int = 2048
+    hidden_size: int = 4096
+    num_layers: int = 28
+    num_heads: int = 16
+    rotary_dim: int = 64
+    intermediate_size: Optional[int] = None   # HF default: 4*hidden
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"
+    vocab_pad_multiple: int = 128
+    decode: bool = False
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def inner_dim(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+PRESETS = {
+    "gptj-tiny": dict(vocab_size=512, hidden_size=64, num_layers=2,
+                      num_heads=4, rotary_dim=8, max_position_embeddings=128),
+    "gptj-6b": dict(hidden_size=4096, num_layers=28, num_heads=16,
+                    rotary_dim=64),
+}
+
+
+def gptj_config(preset: str = "gptj-tiny", **overrides) -> GPTJConfig:
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; valid: {sorted(PRESETS)}")
+    return GPTJConfig(**{**PRESETS[preset], **overrides})
+
+
+def _dense(x, features, names, *, cfg, name, module, bias=True):
+    kernel = module.param(
+        name + "_kernel",
+        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+        (x.shape[-1], features), cfg.param_dtype)
+    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if bias:
+        b = module.param(name + "_bias",
+                         nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+                         (features,), cfg.param_dtype)
+        y = y + b.astype(cfg.dtype)
+    return y
+
+
+class GPTJLayerNorm(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_eps)
+        scale = self.param("scale", nn.with_partitioning(nn.initializers.ones,
+                                                         ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros,
+                                                       ("embed",)),
+                          (x.shape[-1],), self.cfg.param_dtype)
+        return (y * scale + bias).astype(dtype)
+
+
+class GPTJAttention(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x, position_ids, attn_mask):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        q = _dense(x, E, ("embed", "qkv"), cfg=cfg, name="q_proj",
+                   module=self, bias=False).reshape(B, S, H, D)
+        k = _dense(x, E, ("embed", "qkv"), cfg=cfg, name="k_proj",
+                   module=self, bias=False).reshape(B, S, H, D)
+        v = _dense(x, E, ("embed", "qkv"), cfg=cfg, name="v_proj",
+                   module=self, bias=False).reshape(B, S, H, D)
+        q, k = apply_rotary_pos_emb(q, k, position_ids, cfg.rotary_dim,
+                                    interleaved=True)
+        if cfg.decode:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+            idx.value = cur + S
+            q_pos = cur + jnp.arange(S)[:, None]
+            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
+            mask = (k_pos <= q_pos)[None, None, :, :]
+            y = dot_product_attention(q, ck.value, cv.value, causal=False,
+                                      mask=mask, impl="jnp")
+        else:
+            y = dot_product_attention(q, k, v, causal=True, mask=attn_mask,
+                                      impl=cfg.attn_impl)
+        y = y.reshape(B, S, E)
+        return _dense(y, E, ("heads", "embed"), cfg=cfg, name="out_proj",
+                      module=self, bias=False)
+
+
+class GPTJBlock(nn.Module):
+    cfg: GPTJConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, inputs):
+        position_ids, attn_mask = inputs
+        cfg = self.cfg
+        # one shared layernorm feeds BOTH branches (GPT-J parallel residual)
+        h_in = GPTJLayerNorm(cfg, name="ln_1")(x)
+        attn = GPTJAttention(cfg, name="attn")(h_in, position_ids, attn_mask)
+        h = _dense(h_in, cfg.inner_dim, ("embed", "mlp"), cfg=cfg,
+                   name="fc_in", module=self)
+        h = nn.gelu(h, approximate=True)   # HF gelu_new
+        mlp = _dense(h, cfg.hidden_size, ("mlp", "embed"), cfg=cfg,
+                     name="fc_out", module=self)
+        return x + attn + mlp, jnp.zeros((), jnp.float32)
+
+
+class GPTJForCausalLM(nn.Module):
+    cfg: GPTJConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 labels=None, deterministic: bool = True, shift: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        wte = self.param("wte", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")),
+            (cfg.padded_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        if position_ids is None:
+            if cfg.decode:
+                raise ValueError("decode mode requires explicit position_ids")
+            position_ids = jnp.arange(S)[None, :]
+        h = wte.astype(cfg.dtype)[input_ids]
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        block_cls = GPTJBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                GPTJBlock, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                prevent_cse=False)
+        if cfg.scan_layers:
+            stack = nn.scan(block_cls,
+                            variable_axes={"params": 0, "cache": 0},
+                            split_rngs={"params": True, "dropout": True},
+                            length=cfg.num_layers,
+                            in_axes=nn.broadcast,
+                            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, _ = stack(cfg, deterministic, name="h")(h, (position_ids, mask))
+        else:
+            for i in range(cfg.num_layers):
+                h, _ = block_cls(cfg, deterministic, name=f"h_{i}")(
+                    h, (position_ids, mask))
+
+        h = GPTJLayerNorm(cfg, name="ln_f")(h)
+        # untied lm_head with bias (HF GPT-J)
+        logits = _dense(h, cfg.padded_vocab_size, ("embed", "vocab"), cfg=cfg,
+                        name="lm_head", module=self)
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+            logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            tgt = shift_labels(labels) if shift else labels
+            out["loss"] = cross_entropy_loss(logits, tgt)
+        return out
+
+    def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
+        S = seq_len or min(self.cfg.max_position_embeddings, 128)
+        ids = jnp.zeros((batch_size, S), jnp.int32)
+        return {"input_ids": ids, "labels": ids}
+
+    def flops_per_token(self) -> float:
+        cfg = self.cfg
+        E, L = cfg.hidden_size, cfg.num_layers
+        n = (2 * cfg.padded_vocab_size * E
+             + L * (4 * E * E + 2 * E * cfg.inner_dim))
+        return 6.0 * n + 12 * L * E * cfg.max_position_embeddings

@@ -1,0 +1,252 @@
+"""GPT-Neo model family, TPU-native.
+
+Parity target: the reference's GPT-Neo injection policy
+(``module_inject/replace_policy.py:113`` ``HFGPTNEOLayerPolicy``).
+Architecture: GPT-2-like with learned positions, but separate (bias-free)
+q/k/v projections, UNSCALED attention logits (HF computes q·kᵀ with no
+1/√d factor), and alternating global/local (windowed) attention layers.
+The local/global pattern rides the scanned layer stack as a per-layer
+flag array so the whole depth still compiles to one ``lax.scan``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from .common import ModelOutput, cross_entropy_loss, shift_labels
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 2048
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None   # HF default: 4*hidden
+    window_size: int = 256
+    attention_types: Tuple[str, ...] = ()     # per-layer "global"/"local"
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"
+    vocab_pad_multiple: int = 128
+    decode: bool = False
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def inner_dim(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def layer_attention_types(self) -> Tuple[str, ...]:
+        if self.attention_types:
+            return self.attention_types
+        # HF default: alternate global/local starting with global
+        return tuple("global" if i % 2 == 0 else "local"
+                     for i in range(self.num_layers))
+
+
+PRESETS = {
+    "neo-tiny": dict(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=128, window_size=16),
+    "neo-125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "neo-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
+    "neo-2.7b": dict(hidden_size=2560, num_layers=32, num_heads=20),
+}
+
+
+def gptneo_config(preset: str = "neo-tiny", **overrides) -> GPTNeoConfig:
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; valid: {sorted(PRESETS)}")
+    return GPTNeoConfig(**{**PRESETS[preset], **overrides})
+
+
+def _dense(x, features, names, *, cfg, name, module, bias=True):
+    kernel = module.param(
+        name + "_kernel",
+        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+        (x.shape[-1], features), cfg.param_dtype)
+    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if bias:
+        b = module.param(name + "_bias",
+                         nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+                         (features,), cfg.param_dtype)
+        y = y + b.astype(cfg.dtype)
+    return y
+
+
+class NeoLayerNorm(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_eps)
+        scale = self.param("scale", nn.with_partitioning(nn.initializers.ones,
+                                                         ("embed",)),
+                           (x.shape[-1],), self.cfg.param_dtype)
+        bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros,
+                                                       ("embed",)),
+                          (x.shape[-1],), self.cfg.param_dtype)
+        return (y * scale + bias).astype(dtype)
+
+
+class NeoAttention(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, is_local):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        q = _dense(x, E, ("embed", "qkv"), cfg=cfg, name="q_proj",
+                   module=self, bias=False).reshape(B, S, H, D)
+        k = _dense(x, E, ("embed", "qkv"), cfg=cfg, name="k_proj",
+                   module=self, bias=False).reshape(B, S, H, D)
+        v = _dense(x, E, ("embed", "qkv"), cfg=cfg, name="v_proj",
+                   module=self, bias=False).reshape(B, S, H, D)
+
+        if cfg.decode:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+            idx.value = cur + S
+            q_pos = cur + jnp.arange(S)[:, None]
+            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
+            causal = k_pos <= q_pos
+            window = causal & (k_pos > q_pos - cfg.window_size)
+            mask = jnp.where(is_local, window, causal)[None, None, :, :]
+            y = dot_product_attention(q, ck.value, cv.value, causal=False,
+                                      mask=mask, scale=1.0, impl="jnp")
+        else:
+            q_pos = jnp.arange(S)[:, None]
+            k_pos = jnp.arange(S)[None, :]
+            causal = k_pos <= q_pos
+            window = causal & (k_pos > q_pos - cfg.window_size)
+            mask = jnp.where(is_local, window, causal)[None, None, :, :]
+            if attn_mask is not None:
+                mask = mask & attn_mask
+            # HF GPT-Neo applies NO 1/sqrt(d) scaling (replace_policy.py:113
+            # notes scale_attention=False for this family)
+            y = dot_product_attention(q, k, v, causal=False, mask=mask,
+                                      scale=1.0, impl=cfg.attn_impl)
+        y = y.reshape(B, S, E)
+        return _dense(y, E, ("heads", "embed"), cfg=cfg, name="out_proj",
+                      module=self)
+
+
+class NeoBlock(nn.Module):
+    cfg: GPTNeoConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, inputs, is_local):
+        attn_mask = inputs
+        cfg = self.cfg
+        x = x + NeoAttention(cfg, name="attn")(
+            NeoLayerNorm(cfg, name="ln_1")(x), attn_mask, is_local)
+        h = _dense(NeoLayerNorm(cfg, name="ln_2")(x), cfg.inner_dim,
+                   ("embed", "mlp"), cfg=cfg, name="c_fc", module=self)
+        h = nn.gelu(h, approximate=True)   # HF gelu_new
+        x = x + _dense(h, cfg.hidden_size, ("mlp", "embed"), cfg=cfg,
+                       name="c_proj", module=self)
+        return x, jnp.zeros((), jnp.float32)
+
+
+class GPTNeoForCausalLM(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 labels=None, deterministic: bool = True, shift: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        wte = self.param("wte", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")),
+            (cfg.padded_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wpe = self.param("wpe", nn.with_partitioning(
+            nn.initializers.normal(cfg.initializer_range), (None, "embed")),
+            (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        if position_ids is None:
+            if cfg.decode:
+                raise ValueError("decode mode requires explicit position_ids")
+            position_ids = jnp.arange(S)[None, :]
+        h = (wte.astype(cfg.dtype)[input_ids]
+             + wpe.astype(cfg.dtype)[position_ids])
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        local_flags = jnp.asarray(
+            [t == "local" for t in cfg.layer_attention_types], jnp.bool_)
+        block_cls = NeoBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                NeoBlock, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                prevent_cse=False)
+        if cfg.scan_layers:
+            stack = nn.scan(block_cls,
+                            variable_axes={"params": 0, "cache": 0},
+                            split_rngs={"params": True, "dropout": True},
+                            length=cfg.num_layers,
+                            in_axes=(nn.broadcast, 0),
+                            metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            h, _ = stack(cfg, deterministic, name="h")(h, mask, local_flags)
+        else:
+            for i in range(cfg.num_layers):
+                h, _ = block_cls(cfg, deterministic, name=f"h_{i}")(
+                    h, mask, local_flags[i])
+
+        h = NeoLayerNorm(cfg, name="ln_f")(h)
+        # lm_head tied to wte (HF GPT-Neo ties them)
+        logits = jnp.dot(h, wte.astype(cfg.dtype).T)
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            pad_mask = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+            logits = jnp.where(pad_mask, logits, jnp.finfo(logits.dtype).min)
+
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            tgt = shift_labels(labels) if shift else labels
+            out["loss"] = cross_entropy_loss(logits, tgt)
+        return out
+
+    def dummy_inputs(self, batch_size: int = 2, seq_len: Optional[int] = None):
+        S = seq_len or min(self.cfg.max_position_embeddings, 128)
+        ids = jnp.zeros((batch_size, S), jnp.int32)
+        return {"input_ids": ids, "labels": ids}
+
+    def flops_per_token(self) -> float:
+        cfg = self.cfg
+        E, L = cfg.hidden_size, cfg.num_layers
+        n = (cfg.padded_vocab_size * E
+             + L * (4 * E * E + 2 * E * cfg.inner_dim))
+        return 6.0 * n + 12 * L * E * cfg.max_position_embeddings

@@ -15,6 +15,7 @@ on the device path.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import numpy as np
@@ -48,6 +49,26 @@ def convert_hf_model(hf_model, dtype=None):
 
 def _np(t) -> np.ndarray:
     return t.detach().cpu().numpy()
+
+
+def _stack_t(sd: dict, L: int, fmt: str) -> np.ndarray:
+    """Per-layer Linear (out, in) kernels → stacked (L, in, out)."""
+    return np.stack([sd[fmt.format(i)].T for i in range(L)])
+
+
+def _stack(sd: dict, L: int, fmt: str) -> np.ndarray:
+    return np.stack([sd[fmt.format(i)] for i in range(L)])
+
+
+def _pad_vocab(w: np.ndarray, cfg, axis: int = 0) -> np.ndarray:
+    """Zero-pad the vocab dim of ``w`` up to ``cfg.padded_vocab_size``."""
+    if cfg.padded_vocab_size == cfg.vocab_size:
+        return w
+    n = cfg.padded_vocab_size - cfg.vocab_size
+    pad_shape = list(w.shape)
+    pad_shape[axis] = n
+    return np.concatenate([w.astype(np.float32),
+                           np.zeros(pad_shape, np.float32)], axis=axis)
 
 
 @register_policy("GPT2LMHeadModel")
@@ -148,18 +169,11 @@ def convert_hf_gptneox(hf_model, dtype=None):
     sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = cfg.num_hidden_layers
 
-    def lin_t(fmt):
-        return np.stack([sd[fmt.format(i)].T for i in range(L)])
+    lin_t = functools.partial(_stack_t, sd, L)
 
-    def vec(fmt):
-        return np.stack([sd[fmt.format(i)] for i in range(L)])
+    vec = functools.partial(_stack, sd, L)
 
-    def pad_vocab(w):
-        if cfg.padded_vocab_size != cfg.vocab_size:
-            pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size,
-                            w.shape[1]), np.float32)
-            return np.concatenate([w.astype(np.float32), pad], axis=0)
-        return w
+    pad_vocab = functools.partial(_pad_vocab, cfg=cfg)
 
     params = {
         "embed_in": pad_vocab(sd["gpt_neox.embed_in.weight"]),
@@ -213,18 +227,11 @@ def convert_hf_llama(hf_model, dtype=None):
     sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = cfg.num_hidden_layers
 
-    def lin_t(fmt):
-        return np.stack([sd[fmt.format(i)].T for i in range(L)])
+    lin_t = functools.partial(_stack_t, sd, L)
 
-    def vec(fmt):
-        return np.stack([sd[fmt.format(i)] for i in range(L)])
+    vec = functools.partial(_stack, sd, L)
 
-    def pad_vocab(w):
-        if cfg.padded_vocab_size != cfg.vocab_size:
-            pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size,
-                            w.shape[1]), np.float32)
-            return np.concatenate([w.astype(np.float32), pad], axis=0)
-        return w
+    pad_vocab = functools.partial(_pad_vocab, cfg=cfg)
 
     lm_head = sd.get("lm_head.weight")
     if lm_head is None:  # tied embeddings
@@ -286,8 +293,7 @@ def convert_hf_bert(hf_model, dtype=None):
     def lin_t(fmt):  # (out,in) -> stacked (L, in, out)
         return np.stack([sd[fmt.format(i)].T for i in range(L)])
 
-    def vec(fmt):
-        return np.stack([sd[fmt.format(i)] for i in range(L)])
+    vec = functools.partial(_stack, sd, L)
 
     qkv_kernel = np.concatenate([
         lin_t("bert.encoder.layer.{}.attention.self.query.weight"),
@@ -356,3 +362,122 @@ def convert_hf_bert(hf_model, dtype=None):
 
     logger.info(f"converted HF BERT ({L}L, {cfg.hidden_size}d) to zoo params")
     return BertForPreTraining(cfg), _tree_f32(params)
+
+
+@register_policy("GPTNeoFor")
+def convert_hf_gptneo(hf_model, dtype=None):
+    """HF GPT-Neo → zoo ``GPTNeoForCausalLM`` (policy analog of
+    ``replace_policy.py:113`` ``HFGPTNEOLayerPolicy``).  Separate bias-free
+    q/k/v Linears transpose to (in, out); lm_head stays tied to wte."""
+    import jax.numpy as jnp
+
+    from ..models.gptneo import GPTNeoConfig, GPTNeoForCausalLM
+
+    hc = hf_model.config
+    cfg = GPTNeoConfig(
+        vocab_size=hc.vocab_size,
+        max_position_embeddings=hc.max_position_embeddings,
+        hidden_size=hc.hidden_size,
+        num_layers=hc.num_layers,
+        num_heads=hc.num_heads,
+        intermediate_size=hc.intermediate_size,
+        window_size=hc.window_size,
+        attention_types=tuple(hf_model.transformer.config.attention_layers),
+        layer_norm_eps=hc.layer_norm_epsilon,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        scan_layers=True,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = cfg.num_layers
+
+    lin_t = functools.partial(_stack_t, sd, L)
+
+    vec = functools.partial(_stack, sd, L)
+
+    wte = sd["transformer.wte.weight"].astype(np.float32)
+    if cfg.padded_vocab_size != cfg.vocab_size:
+        pad = np.zeros((cfg.padded_vocab_size - cfg.vocab_size,
+                        cfg.hidden_size), np.float32)
+        wte = np.concatenate([wte, pad], axis=0)
+
+    params = {
+        "wte": wte,
+        "wpe": sd["transformer.wpe.weight"],
+        "ln_f": {"scale": sd["transformer.ln_f.weight"],
+                 "bias": sd["transformer.ln_f.bias"]},
+        "h": {
+            "ln_1": {"scale": vec("transformer.h.{}.ln_1.weight"),
+                     "bias": vec("transformer.h.{}.ln_1.bias")},
+            "ln_2": {"scale": vec("transformer.h.{}.ln_2.weight"),
+                     "bias": vec("transformer.h.{}.ln_2.bias")},
+            "attn": {
+                "q_proj_kernel": lin_t("transformer.h.{}.attn.attention.q_proj.weight"),
+                "k_proj_kernel": lin_t("transformer.h.{}.attn.attention.k_proj.weight"),
+                "v_proj_kernel": lin_t("transformer.h.{}.attn.attention.v_proj.weight"),
+                "out_proj_kernel": lin_t("transformer.h.{}.attn.attention.out_proj.weight"),
+                "out_proj_bias": vec("transformer.h.{}.attn.attention.out_proj.bias"),
+            },
+            "c_fc_kernel": lin_t("transformer.h.{}.mlp.c_fc.weight"),
+            "c_fc_bias": vec("transformer.h.{}.mlp.c_fc.bias"),
+            "c_proj_kernel": lin_t("transformer.h.{}.mlp.c_proj.weight"),
+            "c_proj_bias": vec("transformer.h.{}.mlp.c_proj.bias"),
+        },
+    }
+    logger.info(f"converted HF GPT-Neo ({L}L, {cfg.hidden_size}d) to zoo params")
+    return GPTNeoForCausalLM(cfg), _tree_f32(params)
+
+
+@register_policy("GPTJ")
+def convert_hf_gptj(hf_model, dtype=None):
+    """HF GPT-J → zoo ``GPTJForCausalLM`` (policy analog of
+    ``replace_policy.py:158`` ``HFGPTJLayerPolicy``).  Bias-free q/k/v/out,
+    untied lm_head WITH bias, interleaved rotary."""
+    import jax.numpy as jnp
+
+    from ..models.gptj import GPTJConfig, GPTJForCausalLM
+
+    hc = hf_model.config
+    cfg = GPTJConfig(
+        vocab_size=hc.vocab_size,
+        max_position_embeddings=hc.n_positions,
+        hidden_size=hc.n_embd,
+        num_layers=hc.n_layer,
+        num_heads=hc.n_head,
+        rotary_dim=hc.rotary_dim,
+        intermediate_size=hc.n_inner,
+        layer_norm_eps=hc.layer_norm_epsilon,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        scan_layers=True,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = cfg.num_layers
+
+    lin_t = functools.partial(_stack_t, sd, L)
+
+    vec = functools.partial(_stack, sd, L)
+
+    pad_vocab = functools.partial(_pad_vocab, cfg=cfg)
+
+    params = {
+        "wte": pad_vocab(sd["transformer.wte.weight"]),
+        "ln_f": {"scale": sd["transformer.ln_f.weight"],
+                 "bias": sd["transformer.ln_f.bias"]},
+        "lm_head_kernel": pad_vocab(sd["lm_head.weight"].T, axis=1),
+        "lm_head_bias": pad_vocab(sd["lm_head.bias"]),
+        "h": {
+            "ln_1": {"scale": vec("transformer.h.{}.ln_1.weight"),
+                     "bias": vec("transformer.h.{}.ln_1.bias")},
+            "attn": {
+                "q_proj_kernel": lin_t("transformer.h.{}.attn.q_proj.weight"),
+                "k_proj_kernel": lin_t("transformer.h.{}.attn.k_proj.weight"),
+                "v_proj_kernel": lin_t("transformer.h.{}.attn.v_proj.weight"),
+                "out_proj_kernel": lin_t("transformer.h.{}.attn.out_proj.weight"),
+            },
+            "fc_in_kernel": lin_t("transformer.h.{}.mlp.fc_in.weight"),
+            "fc_in_bias": vec("transformer.h.{}.mlp.fc_in.bias"),
+            "fc_out_kernel": lin_t("transformer.h.{}.mlp.fc_out.weight"),
+            "fc_out_bias": vec("transformer.h.{}.mlp.fc_out.bias"),
+        },
+    }
+    logger.info(f"converted HF GPT-J ({L}L, {cfg.hidden_size}d) to zoo params")
+    return GPTJForCausalLM(cfg), _tree_f32(params)

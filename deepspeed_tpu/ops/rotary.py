@@ -43,10 +43,33 @@ def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
     return out
 
 
+def apply_rotary_interleaved(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                             rotary_dim: Optional[int] = None) -> jax.Array:
+    """GPT-J style ("rotate every two"): channel pairs ``(2i, 2i+1)`` are
+    rotated by angle ``i`` (reference rotary kernel's interleaved mode,
+    ``apply_rotary_pos_emb.cu`` with ``rotate_every_two``)."""
+    D = x.shape[-1]
+    rd = D if rotary_dim is None else rotary_dim
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    half = rd // 2
+    pairs = x_rot.reshape(*x_rot.shape[:-1], half, 2)
+    x1, x2 = pairs[..., 0], pairs[..., 1]
+    cos = cos[:, :, None, :].astype(x.dtype)   # (B, S, 1, rd/2)
+    sin = sin[:, :, None, :].astype(x.dtype)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    out = jnp.stack([out1, out2], axis=-1).reshape(*x_rot.shape)
+    if rd < D:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
+
+
 def apply_rotary_pos_emb(q: jax.Array, k: jax.Array, positions: jax.Array,
                          rotary_dim: Optional[int] = None,
-                         theta: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+                         theta: float = 10000.0,
+                         interleaved: bool = False) -> Tuple[jax.Array, jax.Array]:
     """q/k (B, S, H, D); positions (B, S) int."""
     rd = q.shape[-1] if rotary_dim is None else rotary_dim
     cos, sin = rotary_angles(positions, rd, theta)
-    return (apply_rotary(q, cos, sin, rd), apply_rotary(k, cos, sin, rd))
+    rot = apply_rotary_interleaved if interleaved else apply_rotary
+    return (rot(q, cos, sin, rd), rot(k, cos, sin, rd))

@@ -66,6 +66,13 @@ class Engine:
                  partition_rules: Optional[dict] = None):
         self.config = Config.load(config)
         self.model = model
+        ac = self.config.activation_checkpointing
+        if (ac.enabled and hasattr(model, "cfg")
+                and hasattr(model.cfg, "remat") and not model.cfg.remat):
+            # config-driven remat (reference checkpointing.py:825 configure):
+            # zoo models carry the jax.checkpoint policy on their layer stack
+            self.model = type(model)(dataclasses.replace(
+                model.cfg, remat=True, remat_policy=ac.policy))
         self.client_optimizer = optimizer
         self._partition_rules = dict(TP_RULES if partition_rules is None else partition_rules)
 

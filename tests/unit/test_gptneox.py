@@ -102,8 +102,7 @@ def test_neox_generate():
     ids = np.random.default_rng(0).integers(0, 512, size=(1, 4)).astype(np.int32)
     out = np.asarray(eng.generate(ids, max_new_tokens=6))
     assert out.shape == (1, 10)
-    # cached decode == full forward argmax
+    # cached decode == full forward argmax; prompt tokens aren't generated,
+    # so only the final generated token is comparable
     full = np.asarray(eng(out[:, :-1]), np.float32)
-    np.testing.assert_array_equal(out[:, 1:], full.argmax(-1)[:, :])\
-        if False else None  # prompt tokens aren't generated; check last only
     assert int(out[0, -1]) == int(full.argmax(-1)[0, -1])
