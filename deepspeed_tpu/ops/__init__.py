@@ -1,1 +1,3 @@
 from .attention import dot_product_attention  # noqa: F401
+from .sparse_grads import (SparseTensor, sparse_all_reduce,  # noqa: F401
+                           to_sparse)

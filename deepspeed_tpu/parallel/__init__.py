@@ -1,1 +1,2 @@
 from . import zero  # noqa: F401
+from .tiling import TiledLinear  # noqa: F401

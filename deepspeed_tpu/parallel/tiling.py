@@ -1,0 +1,88 @@
+"""Tiled linear layers — memory-bounded big matmuls.
+
+Analog of reference ``runtime/zero/tiling.py:27`` ``TiledLinear``: a huge
+linear is split into ``in_splits × out_splits`` tiles so that (with ZeRO-3)
+only one tile's weights need to be gathered at a time, bounding peak
+memory by the tile size instead of the full layer.
+
+TPU-native: the kernel is stored as one ``(in_splits, out_splits, in_tile,
+out_tile)`` array sharded on the ``fsdp`` axis, and the forward is a
+``lax.scan`` over input tiles.  Inside a scan XLA all-gathers one tile per
+iteration and frees it after use — exactly the reference's gather/release
+pattern, but derived from dataflow instead of Python hooks.  Combine with
+``jax.checkpoint`` (``remat``) to also bound activation memory.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TiledLinear(nn.Module):
+    """Drop-in dense layer computing ``y = x @ W + b`` tile-by-tile.
+
+    ``in_splits``/``out_splits`` partition the contraction/output dims
+    (both must divide the respective dimension, reference tiling.py
+    asserts the same).
+    """
+
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros
+    # logical names for the (contraction, output) dims — override to place
+    # this layer correctly under TP (e.g. ("mlp", "embed") for a
+    # down-projection)
+    kernel_axes: tuple = ("embed", "mlp")
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        if in_features % self.in_splits or self.features % self.out_splits:
+            raise ValueError(
+                f"in_features {in_features} / features {self.features} not "
+                f"divisible by splits ({self.in_splits}, {self.out_splits})")
+        it = in_features // self.in_splits
+        ot = self.features // self.out_splits
+
+        def tiled_init(key, shape, dtype):
+            # draw on the LOGICAL 2D shape so fan-in/fan-out (and thus the
+            # init distribution) match the untiled dense layer exactly,
+            # then cut into (in_splits, out_splits, it, ot) tiles
+            in_s, out_s, it_, ot_ = shape
+            full = self.kernel_init(key, (in_s * it_, out_s * ot_), dtype)
+            return full.reshape(in_s, it_, out_s, ot_).transpose(0, 2, 1, 3)
+
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(tiled_init, (None, None, *self.kernel_axes)),
+            (self.in_splits, self.out_splits, it, ot), self.param_dtype)
+        kernel = jnp.asarray(kernel, self.dtype)
+
+        batch_shape = x.shape[:-1]
+        xs = x.reshape(*batch_shape, self.in_splits, it)
+        xs = jnp.moveaxis(xs, -2, 0)                      # (in_splits, ..., it)
+
+        def body(acc, tile):
+            x_i, w_i = tile                               # w_i: (out_splits, it, ot)
+            y_i = jnp.einsum("...i,oid->...od", x_i.astype(self.dtype), w_i)
+            return acc + y_i, None
+
+        acc0 = jnp.zeros((*batch_shape, self.out_splits, ot), self.dtype)
+        acc, _ = jax.lax.scan(body, acc0, (xs, kernel))
+        y = acc.reshape(*batch_shape, self.features)
+
+        if self.use_bias:
+            bias = self.param("bias",
+                              nn.with_partitioning(self.bias_init,
+                                                   (self.kernel_axes[-1],)),
+                              (self.features,), self.param_dtype)
+            y = y + jnp.asarray(bias, self.dtype)
+        return y

@@ -174,3 +174,159 @@ def validate_stage_mesh(zero_stage: int, mesh) -> None:
             f"{mesh.shape['dp']}: optimizer/param sharding will be a no-op. "
             "Put data-parallel devices on the 'fsdp' axis (the engine does "
             "this automatically when it builds the mesh).")
+
+
+# ---------------------------------------------------------------------------
+# zero.Init / GatheredParameters — the user-facing partition_parameters API
+# ---------------------------------------------------------------------------
+
+class Init:
+    """Sharded-at-construction parameter init (reference ``zero.Init``,
+    ``partition_parameters.py:529``).
+
+    The reference monkey-patches ``nn.Module.__init__`` so every parameter
+    is partitioned the moment it is created.  In JAX, construction and
+    materialization are already separate: flax modules are metadata until
+    ``init`` runs, so this context simply runs ``model.init`` under ``jit``
+    with sharded ``out_shardings`` — the full tree NEVER exists on one
+    device, which is the whole point of the reference context.
+
+    The engine's ``init_params`` runs the same sharded-init recipe (plus
+    optimizer-state/loss-scale placement, via the shared
+    :func:`param_partition_specs`); this explicit form is for custom
+    loops::
+
+        with zero.Init(mesh=mesh) as zinit:
+            params = zinit.materialize(model, rng, **model.dummy_inputs())
+    """
+
+    def __init__(self, mesh=None, zero_stage: int = 3,
+                 rules: Optional[dict] = None, config_dict_or_path=None,
+                 remote_device: Optional[str] = None, pin_memory: bool = False,
+                 enabled: bool = True, dtype=None, mpu=None):
+        from ..comm import mesh as mesh_mod
+
+        self.mesh = mesh if mesh is not None else mesh_mod.get_mesh(required=False)
+        self.zero_stage = zero_stage if enabled else 0
+        self.rules = dict(TP_RULES if rules is None else rules)
+        self.dtype = dtype
+        # remote_device/pin_memory/mpu accepted for reference-signature
+        # parity; host placement is the swap_tensor module's job
+        if remote_device not in (None, "none"):
+            logger.warning("zero.Init(remote_device=...) is handled by the "
+                           "offload config on TPU; ignoring here")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, model, rng, **inputs):
+        """``model.init`` with per-leaf sharded out_shardings; returns the
+        UNBOXED param tree (leaves are sharded ``jax.Array``s)."""
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            raise ValueError("zero.Init needs a mesh (init_distributed first "
+                             "or pass mesh=)")
+        fake = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(np.shape(x),
+                                getattr(x, "dtype", None)
+                                or np.asarray(x).dtype), inputs)
+        abstract = jax.eval_shape(lambda r: model.init(r, **fake), rng)["params"]
+        specs = param_partition_specs(abstract, self.mesh, self.zero_stage,
+                                      rules=self.rules)
+        shardings = named_shardings(self.mesh, specs)
+
+        def _init(r):
+            params = nn.meta.unbox(model.init(r, **fake)["params"])
+            if self.dtype is not None:
+                params = jax.tree_util.tree_map(
+                    lambda p: p.astype(self.dtype), params)
+            return params
+
+        return jax.jit(_init, out_shardings=shardings)(rng)
+
+
+class GatheredParameters:
+    """Context yielding the FULL (host-gathered, mutable) parameter tree;
+    modifications re-shard on exit (reference ``GatheredParameters``,
+    ``partition_parameters.py:1502`` with ``modifier_rank``).
+
+    Works on an :class:`~deepspeed_tpu.runtime.engine.Engine` (writes the
+    modified tree back into engine state) or a raw param tree (read the
+    re-sharded result from ``.result`` after the block)::
+
+        with GatheredParameters(engine) as full:
+            full["wte"][:4] = 0.0            # numpy, fully materialized
+
+        with GatheredParameters(params) as full:
+            full["w"] *= 2
+        params = ctx.result
+    """
+
+    def __init__(self, source, modifier_rank=0, fwd_module=None, enabled=True):
+        self._engine = source if hasattr(source, "_state") else None
+        self._params = None if self._engine is not None else source
+        # ``enabled`` accepted for signature parity; unlike torch, JAX
+        # arrays are immutable whether or not they're partitioned, so the
+        # gather-to-mutable-numpy behavior is identical either way.
+        self.enabled = enabled
+        self.result = None
+        # modifier_rank parity note: every host runs the same SPMD program,
+        # so "rank 0 modifies, then broadcast" is the only supported mode —
+        # identical mutation on every host IS the broadcast.
+
+    def __enter__(self):
+        self._orig = self._source_tree()
+        self._host = jax.tree_util.tree_map(_gather_to_host, self._orig)
+        return self._host
+
+    def _source_tree(self):
+        if self._engine is not None:
+            return self._engine.params
+        return self._params
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        resharded = jax.tree_util.tree_map(
+            lambda h, o: jax.device_put(
+                jnp_asarray(h, getattr(o, "dtype", None)),
+                getattr(o, "sharding", None)),
+            self._host, self._orig)
+        self.result = resharded
+        if self._engine is not None:
+            import dataclasses as _dc
+
+            self._engine._state = _dc.replace(self._engine._state,
+                                              params=resharded)
+        return False
+
+
+def jnp_asarray(x, dtype):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, dtype)
+
+
+def _gather_to_host(x) -> np.ndarray:
+    """Full host copy of a (possibly cross-host sharded) array.
+
+    ``np.array`` on an array spanning non-addressable devices raises, so
+    replicate on-device first (a collective every process participates in)
+    — then every host holds all the data."""
+    if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding) \
+            and not x.is_fully_replicated:
+        x = jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
+    return np.array(x)
+
+
+def register_external_parameter(module, param) -> None:
+    """Reference ``partition_parameters.py:91`` registers params used outside
+    their owning module so the ZeRO-3 coordinator gathers them.  XLA's
+    dataflow analysis sees every use of every sharded array, so there is
+    nothing to register — kept as an explicit no-op for API parity."""
+    del module, param
