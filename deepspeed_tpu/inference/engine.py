@@ -44,6 +44,8 @@ class InferenceConfig:
     ``deepspeed/__init__.py:222``)."""
 
     mp_size: int = 1
+    ep_size: int = 1                   # expert-parallel serving degree (the
+                                       # _create_ep_parallel_group analog)
     dtype: Any = None                  # default bf16
     max_tokens: Optional[int] = None   # cache length; default model n_positions
     replace_with_kernel_inject: bool = True   # accepted; zoo is always "injected"
@@ -96,8 +98,20 @@ class InferenceEngine:
         if mesh is None:
             mesh = comm.get_mesh(required=False)
         if mesh is None:
-            mesh = build_mesh({"tp": self.config.mp_size, "dp": -1})
+            axes = {"tp": self.config.mp_size, "dp": -1}
+            if self.config.ep_size > 1:
+                axes["ep"] = self.config.ep_size
+            mesh = build_mesh(axes)
             set_mesh(mesh)
+        else:
+            for axis, want in (("tp", self.config.mp_size),
+                               ("ep", self.config.ep_size)):
+                have = mesh.shape.get(axis, 1)
+                if want > 1 and have != want:
+                    raise ValueError(
+                        f"init_inference requested {axis}={want} but the "
+                        f"active mesh has {axis}={have}; build the mesh with "
+                        f"that degree or drop the argument")
         self.mesh = mesh
 
         self.params = None

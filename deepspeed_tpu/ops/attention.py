@@ -21,15 +21,19 @@ import jax
 import jax.numpy as jnp
 
 
+def on_tpu() -> bool:
+    """Shared backend probe (used by the model zoo's kernel dispatch too)."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def _pick_impl(impl: str, q) -> str:
     if impl != "auto":
         return impl
     # flash kernel needs TPU + seq/head_dim tiling; fall back otherwise
-    try:
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:
-        on_tpu = False
-    if on_tpu and q.shape[1] >= 128 and q.shape[3] in (64, 128, 256):
+    if on_tpu() and q.shape[1] >= 128 and q.shape[3] in (64, 128, 256):
         return "flash"
     return "jnp"
 

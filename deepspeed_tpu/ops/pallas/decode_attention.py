@@ -1,0 +1,85 @@
+"""Single-token KV-cache decode attention kernel.
+
+Analog of the reference inference kernel's cached softmax-context path
+(``csrc/transformer/inference/csrc/softmax.cu`` ``attn_softmax_context``:
+one new query attends a growing KV history under triangular masking).  On
+TPU the decode step is one program per batch element: the query rows and
+the cached K/V panel ``(S, H, D)`` live in VMEM (legal blocks: the last two
+dims are the full array dims), scores are masked to the live prefix
+(``length``), and per-head (1, S) x (S, D) matmuls ride the MXU.  The
+cache is read from HBM exactly once, in its native model layout — no
+transpose copy.
+
+Callers should keep the cache panel within VMEM (see ``fits_vmem``);
+the model dispatch falls back to the XLA path otherwise.
+
+``interpret=True`` runs on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024   # half of ~16MB/core for the K+V panel
+
+
+def fits_vmem(s: int, h: int, d: int, itemsize: int) -> bool:
+    return 2 * s * h * d * itemsize <= _VMEM_BUDGET_BYTES
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads):
+    L = len_ref[0]
+    for h in range(n_heads):
+        q = q_ref[0, 0, h].astype(jnp.float32)[None, :] * scale      # (1, D)
+        k = k_ref[0, :, h].astype(jnp.float32)                       # (S, D)
+        v = v_ref[0, :, h].astype(jnp.float32)                       # (S, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (1, S)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < L, s, NEG_INF)
+        m = s.max(axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        denom = e.sum(axis=-1, keepdims=True)
+        o = jax.lax.dot_general(e, v, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) / denom
+        o_ref[0, 0, h] = o[0].astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     length, *, scale: Optional[float] = None,
+                     interpret: bool = False) -> jax.Array:
+    """One decode tick.
+
+    ``q``: ``(B, 1, H, D)`` — the new token's query.
+    ``k_cache``/``v_cache``: ``(B, S_max, H, D)`` — cache AFTER appending
+    the new K/V (model cache layout).
+    ``length``: scalar int — number of valid cache slots (``cur + 1``).
+
+    Returns ``(B, 1, H, D)``.
+    """
+    B, _, H, D = q.shape
+    S = k_cache.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    length = jnp.asarray(length, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, n_heads=H),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+        interpret=interpret,
+    )(length, q, k_cache, v_cache)
+    return out

@@ -65,7 +65,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     m_safe = jnp.where(m == NEG_INF, 0.0, m)
-    lse_ref[0] = m_safe + jnp.log(l_safe)
+    lse_ref[0, 0] = m_safe + jnp.log(l_safe)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -75,8 +75,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     nk = S // block_k
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
 
     hi = jnp.minimum(nk, pl.cdiv((qi + 1) * block_q, block_k)) if causal else nk
 
@@ -115,8 +115,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q)].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
@@ -167,11 +167,11 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -182,7 +182,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
     q, k, v, out, lse = res
     BH, S, D = q.shape
     Sk = k.shape[1]
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -193,8 +193,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -210,8 +210,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),

@@ -72,12 +72,15 @@ class TiledLinear(nn.Module):
 
         def body(acc, tile):
             x_i, w_i = tile                               # w_i: (out_splits, it, ot)
-            y_i = jnp.einsum("...i,oid->...od", x_i.astype(self.dtype), w_i)
+            y_i = jnp.einsum("...i,oid->...od", x_i.astype(self.dtype), w_i,
+                             preferred_element_type=jnp.float32)
             return acc + y_i, None
 
-        acc0 = jnp.zeros((*batch_shape, self.out_splits, ot), self.dtype)
+        # accumulate partial products in f32 and round ONCE at the end, so
+        # tiling stays numerically equivalent to the untiled dense matmul
+        acc0 = jnp.zeros((*batch_shape, self.out_splits, ot), jnp.float32)
         acc, _ = jax.lax.scan(body, acc0, (xs, kernel))
-        y = acc.reshape(*batch_shape, self.features)
+        y = acc.astype(self.dtype).reshape(*batch_shape, self.features)
 
         if self.use_bias:
             bias = self.param("bias",

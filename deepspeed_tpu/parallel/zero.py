@@ -231,10 +231,16 @@ class Init:
         if self.mesh is None:
             raise ValueError("zero.Init needs a mesh (init_distributed first "
                              "or pass mesh=)")
-        fake = jax.tree_util.tree_map(
-            lambda x: jnp.zeros(np.shape(x),
-                                getattr(x, "dtype", None)
-                                or np.asarray(x).dtype), inputs)
+        def _fake(x):
+            # only array-like leaves are zero-faked; Python scalars/flags
+            # (e.g. deterministic=True) must pass through verbatim or the
+            # traced init would take the wrong branch
+            if isinstance(x, (bool, int, float, str)) or x is None:
+                return x
+            return jnp.zeros(np.shape(x), getattr(x, "dtype", None)
+                             or np.asarray(x).dtype)
+
+        fake = jax.tree_util.tree_map(_fake, inputs)
         abstract = jax.eval_shape(lambda r: model.init(r, **fake), rng)["params"]
         specs = param_partition_specs(abstract, self.mesh, self.zero_stage,
                                       rules=self.rules)
@@ -265,14 +271,21 @@ class GatheredParameters:
         with GatheredParameters(params) as full:
             full["w"] *= 2
         params = ctx.result
+
+    ``enabled=False`` (reference pattern ``enabled=(stage == 3)``) is a
+    true no-op: the block receives the ORIGINAL tree — sharded, immutable
+    ``jax.Array`` leaves, not mutable numpy — and nothing is written back
+    on exit.  Unlike torch, the un-gathered leaves are never mutable, so
+    code that writes through the context must run with ``enabled=True``.
     """
 
     def __init__(self, source, modifier_rank=0, fwd_module=None, enabled=True):
         self._engine = source if hasattr(source, "_state") else None
         self._params = None if self._engine is not None else source
-        # ``enabled`` accepted for signature parity; unlike torch, JAX
-        # arrays are immutable whether or not they're partitioned, so the
-        # gather-to-mutable-numpy behavior is identical either way.
+        # ``enabled=False`` is a no-op switch (reference semantics: callers
+        # write ``enabled=(stage == 3)`` to skip the expensive gather):
+        # __enter__ yields the unmodified source tree and __exit__ writes
+        # nothing back.
         self.enabled = enabled
         self.result = None
         # modifier_rank parity note: every host runs the same SPMD program,
@@ -281,6 +294,9 @@ class GatheredParameters:
 
     def __enter__(self):
         self._orig = self._source_tree()
+        if not self.enabled:
+            self.result = self._orig
+            return self._orig
         self._host = jax.tree_util.tree_map(_gather_to_host, self._orig)
         return self._host
 
@@ -290,7 +306,7 @@ class GatheredParameters:
         return self._params
 
     def __exit__(self, exc_type, exc, tb):
-        if exc_type is not None:
+        if exc_type is not None or not self.enabled:
             return False
         resharded = jax.tree_util.tree_map(
             lambda h, o: jax.device_put(

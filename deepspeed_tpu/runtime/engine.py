@@ -89,6 +89,15 @@ class Engine:
         self.config.mesh = MeshConfig.from_dict(dict(mesh.shape))
         self.config.resolve_batch(self.n_devices)
         self.dp_world = data_parallel_size(mesh)
+        if self.config.sparse_gradients:
+            # the reference's sparse path targets slow interconnects; on TPU
+            # grads ride XLA's psum over ICI, which beats a gather of packed
+            # rows. ops.sparse_grads.sparse_all_reduce serves manual
+            # shard_map comm paths — the flag does not rewire the engine.
+            logger.warning(
+                "sparse_gradients=true is advisory on TPU: the engine keeps "
+                "XLA dense reductions; use ops.sparse_grads.sparse_all_reduce "
+                "in shard_map code paths for row-sparse embedding allreduce")
 
         # ---- optimizer + schedule -----------------------------------
         if lr_scheduler is not None and callable(lr_scheduler):
