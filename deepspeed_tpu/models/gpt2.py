@@ -79,19 +79,33 @@ def gpt2_config(preset: str = "gpt2-125m", **overrides) -> GPT2Config:
     return GPT2Config(**{**PRESETS[preset], **overrides})
 
 
-def _dense(x, features, names, *, cfg: GPT2Config, name: str, module: nn.Module,
-           init_std: Optional[float] = None, use_bias: bool = True):
-    """Annotated dense layer: kernel gets logical axis names ``names``."""
+def _dense_params(in_features, features, names, *, cfg: GPT2Config, name: str,
+                  module: nn.Module, init_std: Optional[float] = None,
+                  use_bias: bool = True):
+    """Create an annotated (kernel, bias) pair — the single source of truth
+    for dense-layer naming/partitioning/init, shared by the XLA and fused
+    dispatch paths (checkpoint + HF-policy name compatibility)."""
     std = cfg.initializer_range if init_std is None else init_std
     kernel = module.param(
         name + "_kernel",
         nn.with_partitioning(nn.initializers.normal(std), names),
-        (x.shape[-1], features), cfg.param_dtype)
-    y = jnp.dot(x, kernel.astype(cfg.dtype))
+        (in_features, features), cfg.param_dtype)
+    bias = None
     if use_bias:
         bias = module.param(name + "_bias",
                             nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
                             (features,), cfg.param_dtype)
+    return kernel, bias
+
+
+def _dense(x, features, names, *, cfg: GPT2Config, name: str, module: nn.Module,
+           init_std: Optional[float] = None, use_bias: bool = True):
+    """Annotated dense layer: kernel gets logical axis names ``names``."""
+    kernel, bias = _dense_params(x.shape[-1], features, names, cfg=cfg,
+                                 name=name, module=module, init_std=init_std,
+                                 use_bias=use_bias)
+    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if bias is not None:
         y = y + bias.astype(cfg.dtype)
     return y
 
@@ -190,13 +204,42 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool):
         cfg = self.cfg
-        h = _dense(x, 4 * cfg.n_embd, ("embed", "mlp"), cfg=cfg, name="c_fc", module=self)
+        E, F = cfg.n_embd, 4 * cfg.n_embd
+        proj_std = cfg.initializer_range / (2 * cfg.n_layer) ** 0.5
+        if self._use_fused():
+            # single-kernel FFN: hidden tile never leaves VMEM (the
+            # bandwidth hot spot — see ops/pallas/fused_mlp.py)
+            from ..ops.pallas.fused_mlp import fused_mlp
+
+            w1, b1 = _dense_params(E, F, ("embed", "mlp"), cfg=cfg,
+                                   name="c_fc", module=self)
+            w2, b2 = _dense_params(F, E, ("mlp", "embed"), cfg=cfg,
+                                   name="c_proj", module=self,
+                                   init_std=proj_std)
+            return fused_mlp(x, w1.astype(cfg.dtype), b1.astype(cfg.dtype),
+                             w2.astype(cfg.dtype), b2.astype(cfg.dtype),
+                             block_rows=128)
+        h = _dense(x, F, ("embed", "mlp"), cfg=cfg, name="c_fc", module=self)
         h = nn.gelu(h, approximate=True)  # gelu_new
-        out = _dense(h, cfg.n_embd, ("mlp", "embed"), cfg=cfg, name="c_proj", module=self,
-                     init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)
+        out = _dense(h, E, ("mlp", "embed"), cfg=cfg, name="c_proj", module=self,
+                     init_std=proj_std)
         if cfg.resid_pdrop > 0.0 and not deterministic:
             out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
         return out
+
+    def _use_fused(self) -> bool:
+        cfg = self.cfg
+        if cfg.resid_pdrop > 0.0 or not on_tpu():
+            return False
+        # the pallas call is opaque to the SPMD partitioner: single-device
+        # only (multi-chip goes through XLA's own fusion until a shard_map
+        # wrapper lands)
+        if jax.device_count() != 1:
+            return False
+        from ..ops.pallas.fused_mlp import fits_vmem
+
+        return fits_vmem(cfg.n_embd, 4 * cfg.n_embd, 128,
+                         jnp.dtype(cfg.dtype).itemsize)
 
 
 class Block(nn.Module):

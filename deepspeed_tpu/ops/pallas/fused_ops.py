@@ -44,11 +44,13 @@ def _pad_rows(x2: jax.Array, block: int) -> Tuple[jax.Array, int]:
 # ---------------------------------------------------------------------------
 
 def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    # mean/rstd are carried (rows, 1): a partial 1-D block over (R,) hits
+    # Mosaic's 1024-lane 1-D tiling and fails to lower on hardware
     x = x_ref[...].astype(jnp.float32)                     # (rows, D)
-    mean = x.mean(axis=-1)
-    var = jnp.mean(jnp.square(x), axis=-1) - jnp.square(mean)
+    mean = x.mean(axis=-1, keepdims=True)                  # (rows, 1)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True) - jnp.square(mean)
     rstd = jax.lax.rsqrt(var + eps)
-    xhat = (x - mean[:, None]) * rstd[:, None]
+    xhat = (x - mean) * rstd
     y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
     y_ref[...] = y.astype(y_ref.dtype)
     mean_ref[...] = mean
@@ -59,17 +61,17 @@ def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
                    dx_ref, dg_ref, db_ref):
     x = x_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
-    mean = mean_ref[...]
+    mean = mean_ref[...]                                   # (rows, 1)
     rstd = rstd_ref[...]
-    xhat = (x - mean[:, None]) * rstd[:, None]
+    xhat = (x - mean) * rstd
     dxhat = dy * g_ref[...].astype(jnp.float32)
     m1 = dxhat.mean(axis=-1, keepdims=True)
     m2 = (dxhat * xhat).mean(axis=-1, keepdims=True)
-    dx = rstd[:, None] * (dxhat - m1 - xhat * m2)
+    dx = rstd * (dxhat - m1 - xhat * m2)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    # per-row-block partial reductions; summed over blocks by the caller
-    dg_ref[...] = (dy * xhat).sum(axis=0, keepdims=True)
-    db_ref[...] = dy.sum(axis=0, keepdims=True)
+    # per-row-block partial reductions (nb, 1, D); summed by the caller
+    dg_ref[...] = (dy * xhat).sum(axis=0)[None, None, :]
+    db_ref[...] = dy.sum(axis=0)[None, None, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -91,13 +93,13 @@ def _layer_norm_fwd(x, gamma, beta, eps, block_rows, interpret):
         ],
         out_specs=[
             pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, D), x.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x, gamma, beta)
@@ -114,24 +116,24 @@ def _layer_norm_bwd(eps, block_rows, interpret, res, dy):
         in_specs=[
             pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
             pl.BlockSpec((D,), lambda i: (0,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
             pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, D), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, D), lambda i: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, D), x.dtype),
-            jax.ShapeDtypeStruct((nb, D), jnp.float32),
-            jax.ShapeDtypeStruct((nb, D), jnp.float32),
+            jax.ShapeDtypeStruct((nb, 1, D), jnp.float32),
+            jax.ShapeDtypeStruct((nb, 1, D), jnp.float32),
         ],
         interpret=interpret,
     )(x, gamma, mean, rstd, dy)
-    dgamma = dg_part.sum(axis=0).astype(gamma.dtype)
-    dbeta = db_part.sum(axis=0).astype(gamma.dtype)
+    dgamma = dg_part.sum(axis=(0, 1)).astype(gamma.dtype)
+    dbeta = db_part.sum(axis=(0, 1)).astype(gamma.dtype)
     return dx, dgamma, dbeta
 
 
@@ -183,7 +185,7 @@ def _bias_gelu_bwd_kernel(x_ref, b_ref, dy_ref, dx_ref, db_ref):
     u = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
     dx = dy_ref[...].astype(jnp.float32) * _gelu_tanh_grad(u)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    db_ref[...] = dx.sum(axis=0, keepdims=True)
+    db_ref[...] = dx.sum(axis=0)[None, None, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -222,15 +224,15 @@ def _bias_gelu_bwd(block_rows, interpret, res, dy):
         ],
         out_specs=[
             pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, D), lambda i: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, D), x.dtype),
-            jax.ShapeDtypeStruct((nb, D), jnp.float32),
+            jax.ShapeDtypeStruct((nb, 1, D), jnp.float32),
         ],
         interpret=interpret,
     )(x, bias, dy)
-    return dx, db_part.sum(axis=0).astype(bias.dtype)
+    return dx, db_part.sum(axis=(0, 1)).astype(bias.dtype)
 
 
 _bias_gelu.defvjp(_bias_gelu_fwd, _bias_gelu_bwd)
