@@ -209,16 +209,21 @@ class MLP(nn.Module):
         if self._use_fused():
             # single-kernel FFN: hidden tile never leaves VMEM (the
             # bandwidth hot spot — see ops/pallas/fused_mlp.py)
-            from ..ops.pallas.fused_mlp import fused_mlp
+            from ..ops.pallas.fused_mlp import fused_mlp_spmd
 
             w1, b1 = _dense_params(E, F, ("embed", "mlp"), cfg=cfg,
                                    name="c_fc", module=self)
             w2, b2 = _dense_params(F, E, ("mlp", "embed"), cfg=cfg,
                                    name="c_proj", module=self,
                                    init_std=proj_std)
-            return fused_mlp(x, w1.astype(cfg.dtype), b1.astype(cfg.dtype),
-                             w2.astype(cfg.dtype), b2.astype(cfg.dtype),
-                             block_rows=128)
+            y = fused_mlp_spmd(x, w1.astype(cfg.dtype), b1.astype(cfg.dtype),
+                               w2.astype(cfg.dtype), b2.astype(cfg.dtype),
+                               block_rows=128)
+            if y is not None:
+                return y
+            h = nn.gelu(jnp.dot(x, w1.astype(cfg.dtype)) + b1.astype(cfg.dtype),
+                        approximate=True)
+            return jnp.dot(h, w2.astype(cfg.dtype)) + b2.astype(cfg.dtype)
         h = _dense(x, F, ("embed", "mlp"), cfg=cfg, name="c_fc", module=self)
         h = nn.gelu(h, approximate=True)  # gelu_new
         out = _dense(h, E, ("mlp", "embed"), cfg=cfg, name="c_proj", module=self,
@@ -230,11 +235,6 @@ class MLP(nn.Module):
     def _use_fused(self) -> bool:
         cfg = self.cfg
         if cfg.resid_pdrop > 0.0 or not on_tpu():
-            return False
-        # the pallas call is opaque to the SPMD partitioner: single-device
-        # only (multi-chip goes through XLA's own fusion until a shard_map
-        # wrapper lands)
-        if jax.device_count() != 1:
             return False
         from ..ops.pallas.fused_mlp import fits_vmem
 

@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def on_tpu() -> bool:
@@ -62,15 +63,50 @@ def dot_product_attention(
         return _sp_attention(q, k, v, causal=causal, scale=scale, kind=impl)
     impl = _pick_impl(impl, q)
     if impl == "flash" and bias is None and mask is None and dropout_rate == 0.0:
-        try:
-            from .pallas.flash_attention import flash_attention
-
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        except Exception:  # missing kernel support on this backend/shape
-            impl = "jnp"
+        out = _flash_spmd(q, k, v, causal=causal, scale=scale)
+        if out is not None:
+            return out
     return _jnp_attention(q, k, v, causal=causal, bias=bias, mask=mask,
                           dropout_rate=dropout_rate, dropout_rng=dropout_rng,
                           scale=scale)
+
+
+def _flash_spmd(q, k, v, *, causal, scale, interpret=False):
+    """Flash kernel, SPMD-correct: on a multi-device mesh the pallas_call is
+    opaque to the partitioner (XLA would gather operands), so shard_map it
+    over the batch (dp/fsdp/ep) and head (tp) axes — attention is
+    independent along both.  Returns None when the mesh/shapes are
+    unsupported (caller falls back to the XLA path)."""
+    from functools import partial
+
+    from .pallas.flash_attention import flash_attention
+    from .pallas.spmd import kernel_mesh_plan, _warn_once
+
+    from ..comm.mesh import get_mesh
+
+    B, S, H, D = q.shape
+    verdict, batch_axes = kernel_mesh_plan(B, heads=H, allow_tp=True)
+    if verdict is None:
+        return None
+    kern = partial(flash_attention, causal=causal, scale=scale,
+                   interpret=interpret)
+    try:
+        if verdict == "direct":
+            return kern(q, k, v)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = get_mesh()
+        tp = mesh.shape.get("tp", 1)
+        spec = P(batch_axes if batch_axes else None, None,
+                 "tp" if tp > 1 else None, None)
+        # full-manual: the kernel has no collectives, unused axes replicate
+        mapped = shard_map(kern, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False)
+        return mapped(q, k, v)
+    except Exception as e:  # unsupported shape/backend for the kernel
+        _warn_once("flash_attention", f"{type(e).__name__}: {e}"[:200])
+        return None
 
 
 def _sp_attention(q, k, v, *, causal, scale, kind):

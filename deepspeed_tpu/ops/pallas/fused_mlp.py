@@ -247,3 +247,44 @@ def fits_vmem(e: int, f: int, block_rows: int, itemsize: int) -> bool:
     tiles = block_rows * (f * (4 + itemsize)       # u fp32 + h in x.dtype
                           + 2 * 2 * e * itemsize)  # x/y double-buffered
     return weights + tiles <= 15 * 1024 * 1024
+
+
+def fused_mlp_spmd(x, w1, b1, w2, b2, *, block_rows: int = 128,
+                   interpret: bool = False):
+    """SPMD dispatch for :func:`fused_mlp`: on a multi-device mesh the
+    pallas_call is opaque to the partitioner, so shard_map it over the
+    batch axes with replicated weights (requires tp == 1; under ZeRO-3 the
+    per-layer weight all-gather happens at the shard_map boundary, exactly
+    where XLA would put it anyway).  Returns None when the mesh shards
+    something this kernel cannot handle (caller falls back to XLA).
+    Dispatch policy (pp/sp/tp guards, no-mesh multi-device) lives in
+    :mod:`.spmd`."""
+    from .spmd import kernel_mesh_plan, _warn_once
+
+    verdict, batch_axes = kernel_mesh_plan(x.shape[0], allow_tp=False)
+    if verdict is None:
+        return None
+    try:
+        if verdict == "direct":
+            return fused_mlp(x, w1, b1, w2, b2, block_rows=block_rows,
+                             interpret=interpret)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ...comm.mesh import get_mesh
+
+        xspec = P(batch_axes, *([None] * (x.ndim - 1)))
+        wspec = P(None, None)
+        bspec = P(None)
+        mapped = shard_map(
+            functools.partial(fused_mlp, block_rows=block_rows,
+                              interpret=interpret),
+            mesh=get_mesh(),
+            in_specs=(xspec, wspec, bspec, wspec, bspec),
+            out_specs=xspec,
+            check_vma=False,
+        )
+        return mapped(x, w1, b1, w2, b2)
+    except Exception as e:  # unsupported shape/backend for the kernel
+        _warn_once("fused_mlp", f"{type(e).__name__}: {e}"[:200])
+        return None

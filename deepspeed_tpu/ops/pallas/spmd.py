@@ -1,0 +1,63 @@
+"""Shared SPMD dispatch for Pallas kernels.
+
+A ``pallas_call`` is opaque to XLA's SPMD partitioner: on a sharded mesh
+it must be wrapped in ``shard_map`` (or XLA gathers the operands), and on
+a multi-device process with no registered mesh the only safe answer is
+"don't use the kernel".  Every kernel wrapper shares this decision logic
+so mesh-axis policy lives in ONE place.
+
+Verdicts:
+- ``("direct", None)`` — single device: call the kernel directly.
+- ``("shard", batch_axes)`` — wrap in full-manual shard_map, batch dim
+  sharded over ``batch_axes`` (+ optionally heads over ``tp``).
+- ``(None, None)`` — unsupported (caller falls back to the XLA path).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...comm.mesh import DATA_AXES, get_mesh
+from ...utils.logging import logger
+
+
+def kernel_mesh_plan(batch_size: int, *, heads: Optional[int] = None,
+                     allow_tp: bool = False
+                     ) -> Tuple[Optional[str], Optional[tuple]]:
+    """Decide how a batch-parallel Pallas kernel may run under the mesh.
+
+    ``pp``/``sp`` meshes refuse: pipeline code is already inside a manual
+    shard_map over ``pp`` (nesting full-manual would throw), and ``sp``
+    shards the sequence dim which batch-parallel kernels cannot split.
+    ``tp`` is allowed only when the kernel shards heads (``allow_tp``).
+    """
+    import jax
+
+    mesh = get_mesh(required=False)
+    if mesh is None:
+        if jax.device_count() > 1:
+            return None, None   # unknown shardings: kernel would be opaque
+        return "direct", None
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    if n_dev == 1:
+        return "direct", None
+    if mesh.shape.get("pp", 1) > 1 or mesh.shape.get("sp", 1) > 1:
+        return None, None
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and not (allow_tp and heads is not None and heads % tp == 0):
+        return None, None
+    batch_axes = tuple(a for a in DATA_AXES if mesh.shape.get(a, 1) > 1)
+    bsz = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
+    if batch_size % bsz:
+        return None, None
+    return "shard", batch_axes
+
+
+@functools.lru_cache(maxsize=32)
+def _warn_once(kernel: str, err: str) -> None:
+    logger.warning(
+        f"pallas kernel {kernel} dispatch failed ({err}); falling back to "
+        "the XLA path — investigate if this persists, it is a silent "
+        "performance regression")

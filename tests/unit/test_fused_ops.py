@@ -175,3 +175,31 @@ def test_fused_mlp_multi_f_tile(monkeypatch):
                   argnums=(0, 1, 2, 3))(x, w1, b1, w2, b2)
     for a, r in zip(gp, gr):
         np.testing.assert_allclose(a, r, rtol=3e-4, atol=3e-4)
+
+
+def test_fused_mlp_spmd_on_mesh():
+    """fused_mlp under shard_map on a dp mesh (interpret) matches XLA."""
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.ops.pallas.fused_mlp import fused_mlp_spmd
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"dp": 4, "fsdp": 2})
+    mesh_mod.set_mesh(mesh)
+    try:
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(size=(8, 16, 64)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(64, 256)) * 0.05, jnp.float32)
+        b1 = jnp.zeros((256,), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(256, 64)) * 0.05, jnp.float32)
+        b2 = jnp.zeros((64,), jnp.float32)
+        y = fused_mlp_spmd(x, w1, b1, w2, b2, block_rows=16, interpret=True)
+        assert y is not None
+        ref = jax.nn.gelu(x @ w1 + b1, approximate=True) @ w2 + b2
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        # tp mesh -> refuses (hidden dim sharded)
+        mesh_mod.set_mesh(None)
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"tp": 2, "dp": -1}))
+        assert fused_mlp_spmd(x, w1, b1, w2, b2, interpret=True) is None
+    finally:
+        mesh_mod.set_mesh(None)

@@ -1,0 +1,64 @@
+"""Monitor backends + env report — analogs of reference
+``tests/unit/test_monitor.py`` (MonitorMaster fan-out, event tuples) and
+the ``ds_report`` CLI (``env_report.py``)."""
+import csv
+import io
+import os
+from contextlib import redirect_stdout
+
+from deepspeed_tpu.monitor.monitor import MonitorConfig, MonitorMaster
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "job"})
+    m = MonitorMaster(cfg)
+    assert m.enabled
+    m.write_events([("Train/loss", 1.5, 10), ("Train/lr", 3e-4, 10)])
+    m.write_events([("Train/loss", 1.2, 20)])
+    m.close()
+
+    files = {f for root, _, fs in os.walk(tmp_path) for f in fs}
+    loss_files = [f for f in files if "loss" in f]
+    assert loss_files, files
+    path = next(os.path.join(r, f) for r, _, fs in os.walk(tmp_path)
+                for f in fs if "loss" in f)
+    rows = list(csv.reader(open(path)))
+    assert [r[0] for r in rows[-2:]] == ["10", "20"]
+    assert float(rows[-1][1]) == 1.2
+
+
+def test_monitor_disabled_by_default():
+    m = MonitorMaster(MonitorConfig())
+    assert not m.enabled
+    m.write_events([("x", 1.0, 1)])   # no-op, no crash
+    m.close()
+
+
+def test_tensorboard_monitor(tmp_path):
+    cfg = MonitorConfig(tensorboard={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "tbjob"})
+    m = MonitorMaster(cfg)
+    if not m.enabled:   # no TB writer available in this env
+        return
+    m.write_events([("Train/loss", 2.0, 1)])
+    m.close()
+    written = [f for root, _, fs in os.walk(tmp_path) for f in fs]
+    assert written
+
+
+def test_env_report():
+    """``dstpu_report`` (the ds_report analog) runs and prints the
+    capability matrix."""
+    from deepspeed_tpu.env_report import main, probe_kernels
+
+    probes = probe_kernels()
+    assert isinstance(probes, dict) and probes
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main()
+    out = buf.getvalue()
+    assert rc == 0
+    assert "jax" in out.lower()
