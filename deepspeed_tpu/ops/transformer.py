@@ -51,7 +51,7 @@ class DeepSpeedTransformerConfig:
     adjust_init_range: bool = True
     attn_dropout_checkpoint: bool = False  # → remat
     stochastic_mode: bool = False        # no-op: XLA is deterministic
-    return_tuple: bool = False
+    return_tuple: bool = False      # True → layer returns (out,)
 
     @property
     def dtype(self):
@@ -83,8 +83,10 @@ class DeepSpeedTransformerLayer(nn.Module):
                                self.deterministic)
 
         if cfg.use_remat:
-            return nn.remat(lambda m, x: body(m, x))(self, hidden_states)
-        return body(self, hidden_states)
+            out = nn.remat(lambda m, x: body(m, x))(self, hidden_states)
+        else:
+            out = body(self, hidden_states)
+        return (out,) if cfg.return_tuple else out
 
 
 def _layer_body(mod: nn.Module, cfg: DeepSpeedTransformerConfig, x,
@@ -165,10 +167,14 @@ def _layer_body(mod: nn.Module, cfg: DeepSpeedTransformerConfig, x,
                           ("mlp", "embed"))
     out = None
     if on_tpu():
-        from .pallas.fused_mlp import fused_mlp_spmd
+        from .pallas.fused_mlp import fits_vmem, fused_mlp_spmd
 
-        out = fused_mlp_spmd(ffn_in, w1.astype(dtype), b1.astype(dtype),
-                             w2.astype(dtype), b2.astype(dtype))
+        # fit-gate BEFORE dispatch: a Mosaic VMEM overflow surfaces at the
+        # user's outer jit compile, past any except inside the wrapper
+        if fits_vmem(H, cfg.intermediate_size, 128,
+                     jnp.dtype(dtype).itemsize):
+            out = fused_mlp_spmd(ffn_in, w1.astype(dtype), b1.astype(dtype),
+                                 w2.astype(dtype), b2.astype(dtype))
     if out is None:
         h = nn.gelu(jnp.dot(ffn_in, w1.astype(dtype)) + b1.astype(dtype),
                     approximate=True)

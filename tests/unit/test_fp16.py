@@ -1,0 +1,114 @@
+"""Dynamic loss scaling + fp16-mode engine — analogs of reference
+``tests/unit/test_dynamic_loss_scale.py`` and parts of ``test_fp16.py``."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.runtime.config import Config
+from deepspeed_tpu.runtime.precision import (grads_finite, init_loss_scale,
+                                             update_loss_scale)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _fp16_cfg(**over):
+    cfg = Config.load({"train_micro_batch_size_per_gpu": 1,
+                       "fp16": {"enabled": True, **over}})
+    return cfg.fp16
+
+
+def test_initial_scale_power():
+    st = init_loss_scale(_fp16_cfg(initial_scale_power=8))
+    assert float(st.scale) == 2 ** 8
+
+
+def test_scale_halves_on_overflow_after_hysteresis():
+    cfg = _fp16_cfg(initial_scale_power=4, hysteresis=2, min_loss_scale=1)
+    st = init_loss_scale(cfg)
+    # first overflow consumes hysteresis, scale unchanged
+    st = update_loss_scale(st, jnp.bool_(False), cfg)
+    assert float(st.scale) == 16.0
+    # second overflow shrinks
+    st = update_loss_scale(st, jnp.bool_(False), cfg)
+    assert float(st.scale) == 8.0
+
+
+def test_scale_grows_after_window():
+    cfg = _fp16_cfg(initial_scale_power=4, loss_scale_window=3, hysteresis=1)
+    st = init_loss_scale(cfg)
+    for _ in range(3):
+        st = update_loss_scale(st, jnp.bool_(True), cfg)
+    assert float(st.scale) == 32.0
+    # overflow resets good-step count and halves
+    st = update_loss_scale(st, jnp.bool_(False), cfg)
+    assert float(st.scale) == 16.0 and int(st.good_steps) == 0
+
+
+def test_min_loss_scale_floor():
+    cfg = _fp16_cfg(initial_scale_power=1, hysteresis=1, min_loss_scale=1.0)
+    st = init_loss_scale(cfg)
+    for _ in range(10):
+        st = update_loss_scale(st, jnp.bool_(False), cfg)
+    assert float(st.scale) >= 1.0
+
+
+def test_static_loss_scale_never_moves():
+    cfg = _fp16_cfg(loss_scale=128.0)
+    st = init_loss_scale(cfg)
+    st = update_loss_scale(st, jnp.bool_(False), cfg)
+    st = update_loss_scale(st, jnp.bool_(True), cfg)
+    assert float(st.scale) == 128.0
+
+
+def test_grads_finite_detects_nan_inf():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+    assert bool(grads_finite(good))
+    assert not bool(grads_finite({"a": jnp.array([1.0, jnp.nan])}))
+    assert not bool(grads_finite({"a": jnp.array([jnp.inf])}))
+
+
+def test_fp16_engine_skips_step_on_overflow():
+    """An overflowing micro-batch must not move the params (the reference
+    engine's skipped-step behavior) and must shrink the scale."""
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    cfg = gpt2_config("gpt2-tiny", dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 10.0}},
+                "fp16": {"enabled": True, "initial_scale_power": 4,
+                         "hysteresis": 1},
+                "steps_per_print": 10 ** 9})
+    engine.init_params()
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(engine.train_batch_size, 8)).astype(np.int32)
+    engine.train_batch({"input_ids": ids, "labels": ids})
+    before = jax.device_get(engine.params)
+    scale_before = float(jax.device_get(engine._state.loss_scale.scale))
+
+    # poison one param with inf: grads overflow, step must be skipped
+    import dataclasses as dc
+
+    poisoned = jax.tree_util.tree_map(lambda x: x, engine.params)
+    flat, tree = jax.tree_util.tree_flatten(poisoned)
+    flat[0] = flat[0].at[(0,) * flat[0].ndim].set(jnp.inf)
+    engine._state = dc.replace(engine._state,
+                               params=jax.tree_util.tree_unflatten(tree, flat))
+    engine.train_batch({"input_ids": ids, "labels": ids})
+    after = jax.device_get(engine.params)
+    scale_after = float(jax.device_get(engine._state.loss_scale.scale))
+
+    assert scale_after < scale_before
+    # non-poisoned leaves unchanged (step skipped)
+    flat_b, _ = jax.tree_util.tree_flatten(before)
+    flat_a, _ = jax.tree_util.tree_flatten(after)
+    np.testing.assert_array_equal(np.asarray(flat_b[1]), np.asarray(flat_a[1]))

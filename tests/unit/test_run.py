@@ -1,0 +1,54 @@
+"""Launcher resource-string handling — analog of reference
+``tests/unit/test_run.py`` (hostfile parsing, include/exclude filters; no
+processes are spawned)."""
+import pytest
+
+from deepspeed_tpu.launcher.runner import filter_hosts, parse_hostfile
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_parse_hostfile_slots(tmp_path):
+    path = _write(tmp_path, """
+# comment line
+worker-0 slots=4
+worker-1 slots=8
+worker-2
+""")
+    hosts = parse_hostfile(path)
+    assert hosts == {"worker-0": 4, "worker-1": 8, "worker-2": 1}
+
+
+def test_parse_hostfile_inline_comment(tmp_path):
+    path = _write(tmp_path, "w0 slots=2  # gpu box\n")
+    assert parse_hostfile(path) == {"w0": 2}
+
+
+def test_parse_hostfile_empty_raises(tmp_path):
+    path = _write(tmp_path, "# nothing here\n\n")
+    with pytest.raises(ValueError):
+        parse_hostfile(path)
+
+
+def test_include_filter():
+    hosts = {"a": 4, "b": 4, "c": 2}
+    assert filter_hosts(hosts, include="a,c") == {"a": 4, "c": 2}
+
+
+def test_exclude_filter():
+    hosts = {"a": 4, "b": 4}
+    assert filter_hosts(hosts, exclude="b") == {"a": 4}
+
+
+def test_filters_removing_all_raise():
+    with pytest.raises(ValueError):
+        filter_hosts({"a": 1}, exclude="a")
+
+
+def test_include_then_exclude():
+    hosts = {"a": 1, "b": 2, "c": 3}
+    assert filter_hosts(hosts, include="a,b", exclude="b") == {"a": 1}

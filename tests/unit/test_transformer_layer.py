@@ -62,3 +62,13 @@ def test_layer_remat_matches():
     yp = layer_p.apply({"params": params}, x)
     np.testing.assert_allclose(np.asarray(yr), np.asarray(yp),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_return_tuple():
+    cfg = DeepSpeedTransformerConfig(hidden_size=32, intermediate_size=64,
+                                     heads=2, return_tuple=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.zeros((1, 4, 32), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    out = layer.apply({"params": params}, x)
+    assert isinstance(out, tuple) and out[0].shape == (1, 4, 32)
