@@ -68,10 +68,24 @@ def test_flash_bf16_tolerance():
                                np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2)
 
 
-def test_flash_rejects_ragged_seq():
+def test_flash_ragged_seq_uses_full_block():
+    """Non-power-of-two S falls back to a full-sequence block (legal on
+    TPU: block == full array dim) and stays correct."""
+    import numpy as np
+
+    from deepspeed_tpu.ops.attention import _jnp_attention
+    from deepspeed_tpu.ops.pallas.flash_attention import _largest_dividing_block
+
+    assert _largest_dividing_block(1536, 1024) == 512
+    assert _largest_dividing_block(1152, 1024) == 128
+    assert _largest_dividing_block(100, 1024) == 100
     q, k, v = _qkv(S=100)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = _jnp_attention(q, k, v, causal=True, bias=None, mask=None,
+                         dropout_rate=0.0, dropout_rng=None, scale=None)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
 
 
 def test_flash_spmd_on_mesh():
