@@ -44,6 +44,12 @@ class GPT2Config:
     remat: bool = False                # activation checkpointing per block
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"            # auto | jnp | flash | ring
+    fused_mlp: bool = False            # opt-in Pallas FFN kernel: measured
+                                       # SLOWER e2e than XLA's scheduling on
+                                       # the bench chip once attention is
+                                       # tuned (XLA overlaps the unfused
+                                       # pair; the opaque kernel is a
+                                       # scheduling barrier)
     vocab_pad_multiple: int = 128      # MXU/TP-friendly vocab padding
     decode: bool = False               # KV-cache autoregressive mode
     # Mixture-of-Experts FFN (reference deepspeed/moe usage: MoE replaces
@@ -234,7 +240,7 @@ class MLP(nn.Module):
 
     def _use_fused(self) -> bool:
         cfg = self.cfg
-        if cfg.resid_pdrop > 0.0 or not on_tpu():
+        if not cfg.fused_mlp or cfg.resid_pdrop > 0.0 or not on_tpu():
             return False
         from ..ops.pallas.fused_mlp import fits_vmem
 

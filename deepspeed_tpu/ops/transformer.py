@@ -51,6 +51,9 @@ class DeepSpeedTransformerConfig:
     adjust_init_range: bool = True
     attn_dropout_checkpoint: bool = False  # → remat
     stochastic_mode: bool = False        # no-op: XLA is deterministic
+    fused_mlp: bool = False              # opt-in Pallas FFN (measured slower
+                                         # e2e than XLA's scheduling on the
+                                         # bench chip; see models/gpt2.py)
     return_tuple: bool = False      # True → layer returns (out,)
 
     @property
@@ -166,7 +169,7 @@ def _layer_body(mod: nn.Module, cfg: DeepSpeedTransformerConfig, x,
     w2, b2 = dense_params("output", cfg.intermediate_size, H,
                           ("mlp", "embed"))
     out = None
-    if on_tpu():
+    if cfg.fused_mlp and on_tpu():
         from .pallas.fused_mlp import fits_vmem, fused_mlp_spmd
 
         # fit-gate BEFORE dispatch: a Mosaic VMEM overflow surfaces at the
