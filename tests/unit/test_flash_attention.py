@@ -114,3 +114,26 @@ def test_flash_spmd_on_mesh():
                                    rtol=2e-5, atol=2e-5)
     finally:
         mesh_mod.set_mesh(None)
+
+
+def test_flash_heads_per_program_parity():
+    """The G>1 head-batched grid must match G=1 numerics for the output and
+    ALL THREE gradients (dq via _dq_kernel, dk/dv via _dkv_kernel)."""
+    import numpy as np
+
+    q, k, v = _qkv(B=2, H=4)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    f1 = lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                         heads_per_program=1, interpret=True)
+    f2 = lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                         heads_per_program=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(f1(q, k, v)),
+                               np.asarray(f2(q, k, v)), rtol=1e-6, atol=1e-6)
+    g1 = jax.grad(loss(f1), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(f2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
