@@ -62,7 +62,8 @@ def init_distributed(mesh_config: MeshConfig | dict | None = None,
                      coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
-                     dist_init_required: Optional[bool] = None):
+                     dist_init_required: Optional[bool] = None,
+                     dcn: Optional[dict] = None):
     """Join the job-wide rendezvous and install the global mesh.
 
     Analog of reference ``comm.py:376`` ``init_distributed``.  On a TPU pod
@@ -94,7 +95,7 @@ def init_distributed(mesh_config: MeshConfig | dict | None = None,
         jax.distributed.initialize(**kwargs)
     _INITIALIZED = True
 
-    m = build_mesh(mesh_config)
+    m = build_mesh(mesh_config, dcn=dcn)
     set_mesh(m)
     log_dist(f"initialized mesh {dict(m.shape)} over {len(m.devices.flat)} devices", ranks=[0])
     return m

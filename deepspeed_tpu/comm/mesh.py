@@ -93,7 +93,8 @@ class MeshConfig:
 
 
 def build_mesh(config: MeshConfig | dict | None = None,
-               devices: Optional[Sequence] = None):
+               devices: Optional[Sequence] = None,
+               dcn: Optional[dict] = None):
     """Create a ``jax.sharding.Mesh`` with the canonical named axes.
 
     Device order: JAX returns devices in a topology-aware order; we reshape
@@ -101,6 +102,16 @@ def build_mesh(config: MeshConfig | dict | None = None,
     (tolerates DCN), mirroring how the reference puts model-parallel ranks
     on NVLink and pipeline stages across nodes
     (``runtime/pipe/topology.py:246`` axis order ``['pipe','data','model']``).
+
+    **Multi-slice (DCN)**: pass ``dcn={"dp": n_slices}`` (or in the config
+    dict as ``{"mesh": {"dcn": {...}, ...}}``) to say which axes span the
+    data-center network between slices; the remaining per-axis parallelism
+    stays inside each slice's ICI. Uses
+    ``mesh_utils.create_hybrid_device_mesh`` — the TPU analog of the
+    reference's hierarchical (NVLink-inside, Ethernet-between) NCCL
+    topology. On hardware without slice structure (CPU meshes, single
+    slice) the dcn spec must multiply to 1 or it falls back to a flat mesh
+    with a warning.
     """
     import jax
     from jax.sharding import Mesh
@@ -110,9 +121,47 @@ def build_mesh(config: MeshConfig | dict | None = None,
     if config is None:
         config = MeshConfig()
     elif isinstance(config, dict):
+        config = dict(config)
+        dcn = dcn or config.pop("dcn", None)
         config = MeshConfig.from_dict(config)
     config = config.resolve(len(devices))
     shape = tuple(getattr(config, a) for a in MESH_AXES)
+
+    if dcn:
+        unknown = set(dcn) - set(MESH_AXES)
+        if unknown:
+            raise ValueError(f"unknown dcn axes {sorted(unknown)}; valid: {MESH_AXES}")
+        dcn_full = {a: int(dcn.get(a, 1)) for a in MESH_AXES}
+        for a, d in dcn_full.items():
+            if d < 1:
+                raise ValueError(f"dcn[{a}]={d} must be >= 1")
+            if getattr(config, a) % d:
+                raise ValueError(
+                    f"dcn[{a}]={d} must divide the {a} axis size {getattr(config, a)}")
+        n_slices = math.prod(dcn_full.values())
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if n_slices > 1 and len(slice_ids) == n_slices:
+            from jax.experimental import mesh_utils
+
+            ici_shape = tuple(getattr(config, a) // dcn_full[a] for a in MESH_AXES)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, tuple(dcn_full[a] for a in MESH_AXES),
+                devices=devices, allow_split_physical_axes=True)
+            return Mesh(dev_array, MESH_AXES)
+        if n_slices > 1 and len(slice_ids) > 1:
+            # real multi-slice hardware with a mismatched spec: a flat
+            # fallback would lay ICI axes across DCN — fail fast instead
+            raise ValueError(
+                f"mesh dcn spec {dcn} implies {n_slices} slices but devices "
+                f"expose {len(slice_ids)}; fix the dcn spec to match the job")
+        if n_slices > 1:
+            from ..utils.logging import logger
+
+            logger.warning(
+                f"mesh dcn spec {dcn} requests {n_slices} slices but devices "
+                "expose no slice structure; building a flat (ICI-ordered) "
+                "mesh (CPU/single-slice emulation)")
+
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, MESH_AXES)
 

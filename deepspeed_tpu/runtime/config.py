@@ -239,6 +239,9 @@ class Config:
         default_factory=ActivationCheckpointingConfig)
     monitor: MonitorConfig = dataclasses.field(default_factory=MonitorConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # multi-slice spec: which mesh axes span the DCN between slices
+    # (``mesh: {"dcn": {"dp": n_slices}, ...}``); see comm.mesh.build_mesh
+    mesh_dcn: Optional[dict] = None
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
@@ -317,6 +320,7 @@ class Config:
     @staticmethod
     def from_dict(d: dict) -> "Config":
         d = dict(d or {})
+        mesh_d = _take(d, C.MESH, {}) or {}
         cfg = Config(
             train_batch_size=int(_take(d, C.TRAIN_BATCH_SIZE, 0) or 0),
             train_micro_batch_size_per_gpu=int(_take(d, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, 0) or 0),
@@ -338,7 +342,9 @@ class Config:
                 wandb=dict(_take(d, C.WANDB, {}) or {}),
                 csv_monitor=dict(_take(d, C.CSV_MONITOR, {}) or {}),
             ),
-            mesh=MeshConfig.from_dict(_take(d, C.MESH, {}) or {}),
+            mesh=MeshConfig.from_dict({
+                k: v for k, v in mesh_d.items() if k != "dcn"}),
+            mesh_dcn=mesh_d.get("dcn"),
             wall_clock_breakdown=bool(_take(d, C.WALL_CLOCK_BREAKDOWN, False)),
             memory_breakdown=bool(_take(d, C.MEMORY_BREAKDOWN, False)),
             communication_data_type=_take(d, C.COMMUNICATION_DATA_TYPE),

@@ -81,7 +81,8 @@ class Engine:
             mesh = comm.get_mesh(required=False)
         if mesh is None:
             mesh = comm.init_distributed(self._promoted_mesh_config(),
-                                         dist_init_required=dist_init_required)
+                                         dist_init_required=dist_init_required,
+                                         dcn=self.config.mesh_dcn)
         self.mesh = mesh
         set_mesh(mesh)
         zero_lib.validate_stage_mesh(self.zero_stage, mesh)
@@ -229,6 +230,11 @@ class Engine:
         mc = self.config.mesh
         if self.config.zero.stage >= 1 and mc.fsdp == 1:
             mc = dataclasses.replace(mc, fsdp=mc.dp, dp=1)
+            if self.config.mesh_dcn and "dp" in self.config.mesh_dcn:
+                # the dcn spec must ride along with the promoted axis
+                dcn = dict(self.config.mesh_dcn)
+                dcn["fsdp"] = dcn.pop("dp")
+                self.config.mesh_dcn = dcn
         return mc
 
     # ------------------------------------------------------------------

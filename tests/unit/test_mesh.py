@@ -129,3 +129,71 @@ def test_host_plane_single_process():
     tree = {"a": np.ones(3)}
     out = comm.host_broadcast(tree)
     np.testing.assert_allclose(out["a"], tree["a"])
+
+
+def test_dcn_mesh_spec_validation():
+    """Multi-slice spec: validated, and falls back flat (with the right
+    resolved shape) when devices expose no slice structure (CPU mesh)."""
+    import pytest
+
+    from deepspeed_tpu.comm.mesh import build_mesh
+
+    # valid spec on sliceless devices -> flat fallback, shape preserved
+    m = build_mesh({"dp": 4, "tp": 2}, dcn={"dp": 2})
+    assert m.shape["dp"] == 4 and m.shape["tp"] == 2
+
+    # dcn must divide the axis
+    with pytest.raises(ValueError):
+        build_mesh({"dp": 4, "tp": 2}, dcn={"dp": 3})
+    # unknown dcn axis
+    with pytest.raises(ValueError):
+        build_mesh({"dp": 8}, dcn={"zz": 2})
+
+
+def test_dcn_via_engine_config():
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    mesh_mod.set_mesh(None)
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(gpt2_config("gpt2-tiny", dtype=np.float32)),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "mesh": {"dp": 8, "dcn": {"dp": 2}}})
+        assert engine.mesh.shape["dp"] == 8
+        assert engine.config.mesh_dcn == {"dp": 2}
+    finally:
+        mesh_mod.set_mesh(None)
+
+
+def test_dcn_with_zero_promotion():
+    """ZeRO >= 1 promotes dp -> fsdp; the dcn spec must ride along."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    mesh_mod.set_mesh(None)
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(gpt2_config("gpt2-tiny", dtype=np.float32)),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2},
+                    "mesh": {"dp": 8, "dcn": {"dp": 2}}})
+        assert engine.mesh.shape["fsdp"] == 8
+        assert engine.config.mesh_dcn == {"fsdp": 2}
+    finally:
+        mesh_mod.set_mesh(None)
+
+
+def test_dcn_rejects_nonpositive():
+    import pytest
+
+    from deepspeed_tpu.comm.mesh import build_mesh
+
+    with pytest.raises(ValueError):
+        build_mesh({"dp": 8}, dcn={"dp": 0})
