@@ -122,7 +122,8 @@ def build_mesh(config: MeshConfig | dict | None = None,
         config = MeshConfig()
     elif isinstance(config, dict):
         config = dict(config)
-        dcn = dcn or config.pop("dcn", None)
+        embedded_dcn = config.pop("dcn", None)
+        dcn = dcn if dcn is not None else embedded_dcn
         config = MeshConfig.from_dict(config)
     config = config.resolve(len(devices))
     shape = tuple(getattr(config, a) for a in MESH_AXES)

@@ -121,8 +121,29 @@ def _sp_attention(q, k, v, *, causal, scale, kind):
         # no sequence-parallel axis: plain attention
         return _jnp_attention(q, k, v, causal=causal, bias=None, mask=None,
                               dropout_rate=0.0, dropout_rng=None, scale=scale)
-    from ..parallel.ring_attention import ring_attention, ulysses_attention
+    from ..parallel.ring_attention import (ring_attention,
+                                           ring_attention_flash,
+                                           ulysses_attention)
 
+    others = {a: s for a, s in mesh.shape.items() if a != "sp" and s > 1}
+    if kind == "ring" and on_tpu() and not others and q.shape[3] in (64, 128, 256):
+        # flash block engine (pallas): needs full-manual shard_map, which
+        # is only safe when sp is the sole active axis (a pallas_call under
+        # auto-sharded batch axes is opaque to the partitioner)
+        try:
+            mapped = shard_map(
+                partial(ring_attention_flash, axis_name="sp", causal=causal,
+                        scale=scale),
+                mesh=mesh,
+                in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+                out_specs=P(None, "sp"),
+                check_vma=False,
+            )
+            return mapped(q, k, v)
+        except Exception as e:  # unsupported shape/backend: jnp ring below
+            from .pallas.spmd import _warn_once
+
+            _warn_once("ring_attention_flash", f"{type(e).__name__}: {e}"[:200])
     fn = ring_attention if kind == "ring" else ulysses_attention
     mapped = shard_map(
         partial(fn, axis_name="sp", causal=causal, scale=scale),

@@ -196,9 +196,16 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, G, interpret):
 
 def _flash_bwd(causal, scale, block_q, block_k, G, interpret, res, do):
     q, k, v, out, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    return _flash_bwd_impl(causal, scale, block_q, block_k, G, interpret,
+                           q, k, v, lse, do, delta)
+
+
+def _flash_bwd_impl(causal, scale, block_q, block_k, G, interpret,
+                    q, k, v, lse, do, delta):
     BH, S, D = q.shape
     Sk = k.shape[1]
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -277,3 +284,61 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         hpp * Sk * D * q.dtype.itemsize <= 512 * 1024 else 1
     out = _flash(qt, kt, vt, causal, scale, block_q, block_k, G, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# LSE-exposing variant — building block for distributed (ring) attention
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, G, interpret):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k, G,
+                          interpret)
+    return out, res[4][:, 0, :]          # lse as (BH, S)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, G, interpret):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k, G,
+                          interpret)
+    return (out, res[4][:, 0, :]), res
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, G, interpret, res, ct):
+    do, dlse = ct
+    q, k, v, out, lse = res
+    # the lse cotangent folds into the shared backward exactly:
+    # ds = p·(dp - δ') with δ' = δ - dlse, because ∂lse_i/∂s_ij = p_ij
+    delta = (jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                     axis=-1) - dlse.astype(jnp.float32))[:, None, :]
+    return _flash_bwd_impl(causal, scale, block_q, block_k, G, interpret,
+                           q, k, v, lse, do, delta)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                             causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: int = 1024, block_k: int = 1024,
+                             interpret: bool = False):
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``(B, S, H)`` — differentiable in BOTH outputs, which is what a
+    distributed (ring) attention needs to merge per-block results exactly.
+    """
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    block_q = _largest_dividing_block(S, block_q)
+    block_k = _largest_dividing_block(Sk, block_k)
+    qt = _flatten_bh(q.transpose(0, 2, 1, 3))
+    kt = _flatten_bh(k.transpose(0, 2, 1, 3))
+    vt = _flatten_bh(v.transpose(0, 2, 1, 3))
+    G = HEADS_PER_PROGRAM if (B * H) % HEADS_PER_PROGRAM == 0 and \
+        HEADS_PER_PROGRAM * Sk * D * q.dtype.itemsize <= 512 * 1024 else 1
+    out, lse = _flash_lse(qt, kt, vt, causal, scale, block_q, block_k, G,
+                          interpret)
+    out = out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    lse = lse.reshape(B, H, S).transpose(0, 2, 1)
+    return out, lse

@@ -135,3 +135,71 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                             dropout_rate=0.0, dropout_rng=None)
     out = attend_fn(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(out)
+
+
+def ring_attention_flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis_name: str = "sp", causal: bool = True,
+                         scale: Optional[float] = None,
+                         interpret: bool = False) -> jax.Array:
+    """Ring attention with the flash kernel as the block engine.
+
+    Same semantics as :func:`ring_attention` (exact attention over a
+    sequence sharded on ``axis_name``; must run inside ``shard_map``), but
+    each ring step runs the Pallas flash kernel on the visiting KV shard
+    and per-block results merge by logsumexp — so the (Sq, Sk) score block
+    never materializes in HBM and the backward reuses the flash backward
+    kernels via :func:`flash_attention_with_lse`'s exact dlse path.
+
+    Block relation to the diagonal picks the kernel mode per step:
+    past block → causal=False, diagonal → causal=True, future → skipped
+    (lse = -inf contributes zero through the merge).
+    """
+    from ..ops.pallas.flash_attention import flash_attention_with_lse
+
+    B, S, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = D ** -0.5
+
+    pvary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+
+    def block(q, k_blk, v_blk, kv_idx):
+        def full(_):
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=False,
+                                            scale=scale, interpret=interpret)
+
+        def diag(_):
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=True,
+                                            scale=scale, interpret=interpret)
+
+        def future(_):
+            return (jnp.zeros((B, S, H, D), q.dtype),
+                    jnp.full((B, S, H), -jnp.inf, jnp.float32))
+
+        if not causal:            # bidirectional: every block attends fully
+            return full(None)
+        rel = jnp.where(kv_idx == my_idx, 1, jnp.where(kv_idx < my_idx, 0, 2))
+        return lax.switch(rel, (full, diag, future), None)
+
+    def body(carry, _):
+        o_run, lse_run, kv, kv_idx = carry
+        k_blk, v_blk = kv
+        o_j, lse_j = block(q, k_blk, v_blk, kv_idx)
+        # logsumexp merge (both -inf-safe): new total and mixing weights
+        lse_new = jnp.logaddexp(lse_run, lse_j)
+        w_run = jnp.exp(lse_run - lse_new)
+        w_j = jnp.exp(lse_j - lse_new)
+        w_run = jnp.where(jnp.isfinite(lse_run), w_run, 0.0)
+        w_j = jnp.where(jnp.isfinite(lse_j), w_j, 0.0)
+        # carry stays fp32: per-step downcasts would compound rounding
+        o_run = (o_run * w_run[..., None]
+                 + o_j.astype(jnp.float32) * w_j[..., None])
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kv = jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis_name, perm), kv)
+        return (o_run, lse_new, kv, (kv_idx - 1) % n), None
+
+    o0 = pvary(jnp.zeros((B, S, H, D), jnp.float32))
+    lse0 = pvary(jnp.full((B, S, H), -jnp.inf, jnp.float32))
+    (out, _, _, _), _ = lax.scan(body, (o0, lse0, (k, v), my_idx), None, length=n)
+    return out.astype(q.dtype)

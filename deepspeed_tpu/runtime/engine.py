@@ -80,9 +80,10 @@ class Engine:
         if mesh is None:
             mesh = comm.get_mesh(required=False)
         if mesh is None:
-            mesh = comm.init_distributed(self._promoted_mesh_config(),
+            mesh_cfg, dcn = self._promoted_mesh_config()
+            mesh = comm.init_distributed(mesh_cfg,
                                          dist_init_required=dist_init_required,
-                                         dcn=self.config.mesh_dcn)
+                                         dcn=dcn)
         self.mesh = mesh
         set_mesh(mesh)
         zero_lib.validate_stage_mesh(self.zero_stage, mesh)
@@ -225,17 +226,18 @@ class Engine:
     def is_gradient_accumulation_boundary(self) -> bool:
         return self.micro_steps % self.gradient_accumulation_steps == 0
 
-    def _promoted_mesh_config(self) -> MeshConfig:
-        """ZeRO ≥1 wants DP devices on the shardable ``fsdp`` axis."""
+    def _promoted_mesh_config(self):
+        """ZeRO ≥1 wants DP devices on the shardable ``fsdp`` axis.
+        Returns ``(mesh_config, dcn_spec)`` — the dcn spec rides along with
+        the promoted axis (no config mutation)."""
         mc = self.config.mesh
+        dcn = self.config.mesh_dcn
         if self.config.zero.stage >= 1 and mc.fsdp == 1:
             mc = dataclasses.replace(mc, fsdp=mc.dp, dp=1)
-            if self.config.mesh_dcn and "dp" in self.config.mesh_dcn:
-                # the dcn spec must ride along with the promoted axis
-                dcn = dict(self.config.mesh_dcn)
+            if dcn and "dp" in dcn:
+                dcn = dict(dcn)
                 dcn["fsdp"] = dcn.pop("dp")
-                self.config.mesh_dcn = dcn
-        return mc
+        return mc, dcn
 
     # ------------------------------------------------------------------
     # initialization
