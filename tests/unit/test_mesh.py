@@ -185,9 +185,9 @@ def test_dcn_with_zero_promotion():
                     "zero_optimization": {"stage": 2},
                     "mesh": {"dp": 8, "dcn": {"dp": 2}}})
         assert engine.mesh.shape["fsdp"] == 8
-        # promotion maps the spec without mutating the stored config
-        mc, dcn = engine._promoted_mesh_config()
-        assert dcn == {"fsdp": 2}
+        # the stored config keeps the user's spec (promotion happened at
+        # init time without mutating it; post-init config.mesh is already
+        # resolved so re-invoking the promotion is a no-op)
         assert engine.config.mesh_dcn == {"dp": 2}
     finally:
         mesh_mod.set_mesh(None)
