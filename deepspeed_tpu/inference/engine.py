@@ -47,7 +47,9 @@ class InferenceConfig:
     ep_size: int = 1                   # expert-parallel serving degree (the
                                        # _create_ep_parallel_group analog)
     dtype: Any = None                  # default bf16
-    max_tokens: Optional[int] = None   # cache length; default model n_positions
+    max_tokens: Optional[int] = None   # generation/cache limit; resizes the
+                                       # KV cache for rotary models, caps
+                                       # generation for learned-position ones
     replace_with_kernel_inject: bool = True   # accepted; zoo is always "injected"
     checkpoint: Optional[str] = None
     quant: dict = dataclasses.field(default_factory=dict)
@@ -65,6 +67,27 @@ class InferenceConfig:
 
             logger.warning(f"init_inference: ignoring unsupported keys {sorted(extra)}")
         return cfg
+
+
+
+def _params_depend_on(model, cfg, pos_field: str) -> bool:
+    """True when any parameter SHAPE is a function of ``pos_field`` (i.e.
+    the model has a learned position table sized by it)."""
+    import dataclasses as _dc
+
+    def shapes(c):
+        m = type(model)(c)
+        tree = jax.eval_shape(
+            lambda r: m.init(r, jnp.zeros((1, 1), jnp.int32)),
+            jax.random.PRNGKey(0))["params"]
+        return [tuple(l.shape) for l in jax.tree_util.tree_leaves(tree)]
+
+    cur = getattr(cfg, pos_field)
+    alt = _dc.replace(cfg, **{pos_field: cur * 2})
+    try:
+        return shapes(cfg) != shapes(alt)
+    except Exception:
+        return True   # cannot prove independence: be conservative
 
 
 class InferenceEngine:
@@ -89,9 +112,28 @@ class InferenceEngine:
         pos_field = "n_positions" if hasattr(cfg, "n_positions") \
             else "max_position_embeddings"
         self._pos_field = pos_field
+        model_limit = getattr(cfg, pos_field)
+        requested = self.config.max_tokens
+        if requested and requested != model_limit and \
+                _params_depend_on(model, self.model_cfg, pos_field):
+            # learned position table (GPT-2 wpe, BERT, GPT-Neo): resizing
+            # the field would reshape checkpoint params — cache stays at
+            # the model's length and max_tokens only caps generation
+            logger.warning(
+                f"max_tokens={requested} ignored for the cache: this model "
+                f"has learned position embeddings sized by {pos_field}="
+                f"{model_limit}; generation is capped at "
+                f"{min(requested, model_limit)}")
+            self._gen_limit = min(requested, model_limit)
+            decode_len = model_limit
+        else:
+            # rotary-style models: the field only sizes the KV cache, so
+            # max_tokens may shrink it (less HBM) or extend it past the
+            # trained context
+            decode_len = requested or model_limit
+            self._gen_limit = decode_len
         self.decode_cfg = dataclasses.replace(
-            self.model_cfg, decode=True,
-            **{pos_field: self.config.max_tokens or getattr(cfg, pos_field)})
+            self.model_cfg, decode=True, **{pos_field: decode_len})
         self._fwd_model = type(model)(self.model_cfg)
         self._decode_model = type(model)(self.decode_cfg)
 
@@ -237,10 +279,11 @@ class InferenceEngine:
             raise RuntimeError("no parameters loaded; pass params=/checkpoint=")
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, S = input_ids.shape
-        limit = getattr(self.decode_cfg, self._pos_field)
+        limit = self._gen_limit
         if S + max_new_tokens > limit:
             raise ValueError(f"prompt({S}) + max_new_tokens({max_new_tokens}) "
-                             f"exceeds cache length {limit}")
+                             f"exceeds the generation limit {limit} "
+                             f"(max_tokens/model context)")
         cache = self.init_cache(B)
         positions = jnp.arange(S)[None, :].repeat(B, 0)
         logits, cache = self._compiled_prefill(self.params, cache, input_ids, positions)
