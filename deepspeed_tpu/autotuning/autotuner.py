@@ -71,22 +71,38 @@ class Autotuner:
                  micro_batches: Optional[list[int]] = None,
                  zero_stages: Optional[list[int]] = None,
                  remat_options: Optional[list[bool]] = None,
+                 kernel_options: Optional[list[dict]] = None,
                  hbm_budget_fraction: float = 0.9,
                  seq_len: Optional[int] = None):
         self.model = model
         self.base_config = dict(base_config)
         self.base_config.pop("train_batch_size", None)  # derived per trial
+        # a previously-autotuned config must not pre-apply the knobs being
+        # probed (or leak stale winners into the new result)
+        self.base_config.pop("model_overrides", None)
+        self.base_config.pop("autotuned", None)
         tuning = dict(self.base_config.pop("autotuning", {}) or {})
         self.micro_batches = micro_batches or tuning.get(
             "micro_batch_sizes", [1, 2, 4, 8, 16, 32])
         self.zero_stages = zero_stages if zero_stages is not None else \
             tuning.get("zero_stages", [0, 1, 2, 3])
         self.remat_options = remat_options if remat_options is not None else [False, True]
+        # kernel knobs are model-config overrides (e.g. the Pallas fused
+        # FFN): tuned live because compile-time rooflines cannot rank
+        # opaque pallas_calls vs XLA fusions
+        if kernel_options is not None:
+            self.kernel_options = kernel_options
+        else:
+            self.kernel_options = [{}]
+            if hasattr(model, "cfg") and hasattr(model.cfg, "fused_mlp"):
+                self.kernel_options.append(
+                    {"fused_mlp": not model.cfg.fused_mlp})
         self.hbm_budget = _chip_spec()["hbm"] * hbm_budget_fraction
         self.seq_len = seq_len
         self.results: list[TrialResult] = []
 
-    def _trial_engine(self, stage: int, micro: int, remat: bool):
+    def _trial_engine(self, stage: int, micro: int, remat: bool,
+                      kernel: Optional[dict] = None):
         import dataclasses as dc
 
         import deepspeed_tpu
@@ -94,8 +110,13 @@ class Autotuner:
 
         mesh_mod.set_mesh(None)
         model = self.model
+        if kernel and not (hasattr(model, "cfg")
+                           and all(hasattr(model.cfg, k) for k in kernel)):
+            raise ValueError(
+                f"kernel overrides {kernel} not applicable to this model")
         if hasattr(model, "cfg") and hasattr(model.cfg, "remat"):
-            model = type(model)(dc.replace(model.cfg, remat=remat))
+            model = type(model)(dc.replace(model.cfg, remat=remat,
+                                           **(kernel or {})))
         cfg = dict(self.base_config)
         cfg["zero_optimization"] = dict(cfg.get("zero_optimization", {}),
                                         stage=stage)
@@ -104,15 +125,16 @@ class Autotuner:
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
         return engine
 
-    def _probe(self, stage: int, micro: int, remat: bool) -> TrialResult:
+    def _probe(self, stage: int, micro: int, remat: bool,
+               kernel: Optional[dict] = None) -> TrialResult:
         import jax
 
         overrides = {"zero_optimization.stage": stage,
                      "train_micro_batch_size_per_gpu": micro,
-                     "remat": remat}
+                     "remat": remat, "kernel": dict(kernel or {})}
         result = TrialResult(config_overrides=overrides)
         try:
-            engine = self._trial_engine(stage, micro, remat)
+            engine = self._trial_engine(stage, micro, remat, kernel)
             batch = engine.model.dummy_inputs(
                 batch_size=engine.train_batch_size, seq_len=self.seq_len)
             abstract = engine.abstract_state(batch)
@@ -150,12 +172,14 @@ class Autotuner:
         for stage in self.zero_stages:
             for remat in self.remat_options:
                 for micro in self.micro_batches:
-                    r = self._probe(stage, micro, remat)
-                    self.results.append(r)
-                    status = "OOM/err" if (not r.fits or r.error) else \
-                        f"est {1e3*r.est_step_time:.1f}ms"
-                    log_dist(f"autotune stage={stage} micro={micro} "
-                             f"remat={remat}: {status}", ranks=[0])
+                    for kernel in self.kernel_options:
+                        r = self._probe(stage, micro, remat, kernel)
+                        self.results.append(r)
+                        status = "OOM/err" if (not r.fits or r.error) else \
+                            f"est {1e3*r.est_step_time:.1f}ms"
+                        log_dist(f"autotune stage={stage} micro={micro} "
+                                 f"remat={remat} kernel={kernel}: {status}",
+                                 ranks=[0])
         viable = [r for r in self.results if r.fits and not r.error]
         if not viable:
             raise RuntimeError(
@@ -178,6 +202,8 @@ class Autotuner:
             # returned config (engine applies it to the model's layer stack)
             cfg["activation_checkpointing"] = dict(
                 cfg.get("activation_checkpointing", {}), enabled=True)
+        if best.config_overrides.get("kernel"):
+            cfg["model_overrides"] = dict(best.config_overrides["kernel"])
         cfg["autotuned"] = best.config_overrides
         return cfg
 
@@ -192,7 +218,7 @@ class Autotuner:
                 o = r.config_overrides
                 engine = self._trial_engine(o["zero_optimization.stage"],
                                             o["train_micro_batch_size_per_gpu"],
-                                            o["remat"])
+                                            o["remat"], o.get("kernel"))
                 engine.init_params()
                 batch = engine.model.dummy_inputs(
                     batch_size=engine.train_batch_size, seq_len=self.seq_len)

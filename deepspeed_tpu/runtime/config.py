@@ -242,6 +242,10 @@ class Config:
     # multi-slice spec: which mesh axes span the DCN between slices
     # (``mesh: {"dcn": {"dp": n_slices}, ...}``); see comm.mesh.build_mesh
     mesh_dcn: Optional[dict] = None
+    # model-config overrides applied by the engine at init (autotuner
+    # output: kernel knobs like fused_mlp); also records `autotuned`
+    model_overrides: dict = dataclasses.field(default_factory=dict)
+    autotuned: dict = dataclasses.field(default_factory=dict)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
@@ -345,6 +349,8 @@ class Config:
             mesh=MeshConfig.from_dict({
                 k: v for k, v in mesh_d.items() if k != "dcn"}),
             mesh_dcn=mesh_d.get("dcn"),
+            model_overrides=dict(_take(d, "model_overrides", {}) or {}),
+            autotuned=dict(_take(d, "autotuned", {}) or {}),
             wall_clock_breakdown=bool(_take(d, C.WALL_CLOCK_BREAKDOWN, False)),
             memory_breakdown=bool(_take(d, C.MEMORY_BREAKDOWN, False)),
             communication_data_type=_take(d, C.COMMUNICATION_DATA_TYPE),
@@ -375,7 +381,7 @@ class Config:
             C.COMMUNICATION_DATA_TYPE, C.DATALOADER_DROP_LAST, C.SPARSE_GRADIENTS,
             C.CURRICULUM_LEARNING, C.PROGRESSIVE_LAYER_DROP, C.EIGENVALUE,
             C.QUANTIZE_TRAINING, C.FLOPS_PROFILER, C.ELASTICITY, C.AUTOTUNING,
-            C.SPARSE_ATTENTION,
+            C.SPARSE_ATTENTION, "model_overrides", "autotuned",
         }
         for key in d:
             if key not in known_keys:

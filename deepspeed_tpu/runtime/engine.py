@@ -66,6 +66,11 @@ class Engine:
                  partition_rules: Optional[dict] = None):
         self.config = Config.load(config)
         self.model = model
+        if self.config.model_overrides and hasattr(model, "cfg"):
+            # autotuner kernel knobs (fused_mlp etc.) applied to the model
+            model = type(model)(dataclasses.replace(
+                model.cfg, **self.config.model_overrides))
+            self.model = model
         ac = self.config.activation_checkpointing
         if (ac.enabled and hasattr(model, "cfg")
                 and hasattr(model.cfg, "remat") and not model.cfg.remat):

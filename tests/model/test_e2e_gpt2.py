@@ -48,7 +48,7 @@ def _data(n_batches, batch, seq=32, vocab=512, seed=0):
             for _ in range(n_batches)]
 
 
-def _make_engine(tmp=None, config=None):
+def _make_engine(config=None):
     cfg = gpt2_config("gpt2-tiny", dtype=jnp.float32, scan_layers=True)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=GPT2LMHeadModel(cfg), config=config or dict(DS_CONFIG))

@@ -49,3 +49,50 @@ def test_autotuner_trial_engine_isolated():
     r = tuner._probe(3, 1, True)
     assert r.error is None, r.error
     assert np.isfinite(r.est_step_time)
+
+
+def test_autotuner_kernel_options_space():
+    """The search space includes model kernel knobs (fused_mlp) and the
+    winning kernel override lands in the returned config."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    mesh_mod.set_mesh(None)
+    try:
+        model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", dtype=jnp.float32))
+        tuner = Autotuner(model, {"train_micro_batch_size_per_gpu": 1},
+                          micro_batches=[1], zero_stages=[1],
+                          remat_options=[False])
+        assert {} in tuner.kernel_options
+        assert {"fused_mlp": True} in tuner.kernel_options
+        cfg = tuner.tune()
+        kernels_probed = {tuple(sorted(r.config_overrides["kernel"].items()))
+                          for r in tuner.results}
+        assert len(kernels_probed) == 2
+        assert "autotuned" in cfg
+    finally:
+        mesh_mod.set_mesh(None)
+
+
+def test_model_overrides_applied_by_engine():
+    """An autotuned config with model_overrides reconfigures the model."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    mesh_mod.set_mesh(None)
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(gpt2_config("gpt2-tiny", dtype=jnp.float32)),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "model_overrides": {"fused_mlp": True},
+                    "autotuned": {"note": "from a prior tune()"}})
+        assert engine.model.cfg.fused_mlp is True
+    finally:
+        mesh_mod.set_mesh(None)
