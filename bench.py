@@ -28,7 +28,47 @@ PEAK_TFLOPS = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
                "v6 lite": 918e12, "v6e": 918e12, "cpu": 1e12}
 
 
+def bench_decode():
+    """``bench.py --mode decode``: batched decode throughput (tokens/s)
+    through the continuous batcher — the serving analog of the training
+    metric.  Not run by the driver (which wants the training JSON line);
+    kept for measuring the MoE/inference serving claims in BASELINE.md."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    preset, slots, new_toks = ("gpt2-125m", 8, 128) if on_tpu else \
+        ("gpt2-tiny", 4, 16)
+    cfg = gpt2_config(preset)   # bf16 serving (keeps KV panels in VMEM)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0),
+                   np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(32,)).astype(np.int32)
+               for _ in range(slots * 2)]
+    batcher = ContinuousBatcher(eng, n_slots=slots)
+    batcher.run(prompts[:slots], max_new_tokens=4)       # warmup/compile
+    t0 = time.perf_counter()
+    outs = batcher.run(prompts, max_new_tokens=new_toks)
+    dt = time.perf_counter() - t0
+    tokens = sum(len(o) - 32 for o in outs)
+    print(json.dumps({
+        "metric": f"{preset} batched decode tokens/sec ({slots} slots)",
+        "value": round(tokens / dt, 1), "unit": "tokens/s",
+        "vs_baseline": None}), flush=True)
+
+
 def main():
+    if "--mode" in sys.argv and "decode" in sys.argv:
+        return bench_decode()
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -97,9 +97,27 @@ class Autotuner:
             if hasattr(model, "cfg") and hasattr(model.cfg, "fused_mlp"):
                 self.kernel_options.append(
                     {"fused_mlp": not model.cfg.fused_mlp})
+            # flash tiling variants only matter where the flash kernel can
+            # engage (TPU backend; rooflines tie, so these are ranked by
+            # the live-measurement pass)
+            if hasattr(model, "cfg") and hasattr(model.cfg, "flash_block") \
+                    and self._flash_possible(model):
+                self.kernel_options += [
+                    {"flash_block": (512, 512)},
+                    {"flash_block": (256, 256)},
+                    {"flash_heads_per_program": 2},
+                ]
         self.hbm_budget = _chip_spec()["hbm"] * hbm_budget_fraction
         self.seq_len = seq_len
         self.results: list[TrialResult] = []
+
+    @staticmethod
+    def _flash_possible(model) -> bool:
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            return False
+        return getattr(model.cfg, "attn_impl", "jnp") in ("auto", "flash")
 
     def _trial_engine(self, stage: int, micro: int, remat: bool,
                       kernel: Optional[dict] = None):

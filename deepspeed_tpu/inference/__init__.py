@@ -1,1 +1,2 @@
 from .engine import InferenceEngine  # noqa: F401
+from .serving import ContinuousBatcher  # noqa: F401

@@ -244,20 +244,42 @@ class InferenceEngine:
 
         return jax.jit(prefill)
 
-    @functools.lru_cache(maxsize=8)
-    def _compiled_decode_step(self, top_k: int):
-        """One fused decode tick: cache-append forward + sampling.  Compiled
-        once per top_k (static); the CUDA-graph-replay analog."""
+    @functools.lru_cache(maxsize=16)
+    def _compiled_decode_step(self, top_k: int, top_p: float,
+                              temperature: float):
+        """One fused decode tick: cache-append forward + sampling + EOS
+        bookkeeping; the CUDA-graph-replay analog.  ``top_k``/``top_p``/
+        ``temperature`` are STATIC (constant per generate() call, lru-
+        cached) so dead sampling branches — the nucleus sort, the
+        categorical draw under greedy — drop out of the compiled step.
 
-        def step(params, cache, token, position, rng, temperature):
+        Dynamic sampling state rides through the step so nothing leaves
+        the device between ticks: ``seen_mask`` (B, V) powers the
+        repetition penalty, ``done`` (B,) freezes finished sequences (they
+        emit ``pad_id`` from then on), ``eos_id`` < 0 disables EOS.
+        """
+
+        def step(params, cache, token, position, rng,
+                 rep_penalty, seen_mask, done, eos_id, pad_id):
             out, vars_ = self._decode_model.apply(
                 {"params": params, "cache": cache}, token,
                 position_ids=position, mutable=["cache"])
             next_logits = out["logits"][:, -1, :].astype(jnp.float32)
-            next_token = _sample(next_logits, rng, temperature, top_k)
-            return next_token, vars_["cache"]
+            next_token = _sample(next_logits, rng, temperature, top_k,
+                                 top_p, rep_penalty, seen_mask)
+            next_token = jnp.where(done, pad_id, next_token)
+            new_done = jnp.logical_or(done, next_token == eos_id)
+            B = next_token.shape[0]
+            seen_mask = seen_mask.at[jnp.arange(B), next_token].set(True)
+            return next_token, vars_["cache"], seen_mask, new_done
 
         return jax.jit(step)
+
+    @staticmethod
+    def _seen_mask_from(input_ids, vocab_size: int):
+        B = input_ids.shape[0]
+        return jnp.zeros((B, vocab_size), bool).at[
+            jnp.arange(B)[:, None], input_ids].set(True)
 
     def init_cache(self, batch_size: int):
         dummy = jnp.zeros((batch_size, 1), jnp.int32)
@@ -270,10 +292,17 @@ class InferenceEngine:
         return cache
 
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0, eos_token_id: Optional[int] = None):
+                 top_k: int = 0, top_p: float = 1.0,
+                 repetition_penalty: float = 1.0, seed: int = 0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None):
         """Autoregressive generation: compiled prefill + compiled decode step.
 
-        Greedy when ``temperature == 0``.  Returns (B, S+max_new_tokens).
+        Greedy when ``temperature == 0``; ``top_p`` nucleus and
+        ``repetition_penalty`` follow the HF semantics.  Sequences that
+        emit ``eos_token_id`` are frozen individually and padded with
+        ``pad_token_id`` (default: the EOS id); generation stops early
+        when every sequence is done.  Returns (B, S+max_new_tokens).
         """
         if self.params is None:
             raise RuntimeError("no parameters loaded; pass params=/checkpoint=")
@@ -288,33 +317,74 @@ class InferenceEngine:
         positions = jnp.arange(S)[None, :].repeat(B, 0)
         logits, cache = self._compiled_prefill(self.params, cache, input_ids, positions)
         rng = jax.random.PRNGKey(seed)
-        temp = jnp.float32(temperature)
-        decode_step = self._compiled_decode_step(int(top_k))
+        rep_pen = jnp.float32(repetition_penalty)
+        eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        pad = jnp.int32(eos_token_id if pad_token_id is None and
+                        eos_token_id is not None else (pad_token_id or 0))
+        vocab = logits.shape[-1]
+        seen = self._seen_mask_from(input_ids, vocab)
+        done = jnp.zeros((B,), bool)
+        decode_step = self._compiled_decode_step(
+            int(top_k), float(top_p), float(temperature))
 
         rng, sub = jax.random.split(rng)
-        token = _sample(logits[:, -1, :].astype(jnp.float32), sub, temp, int(top_k))
+        token = _sample(logits[:, -1, :].astype(jnp.float32), sub,
+                        float(temperature), int(top_k), float(top_p),
+                        rep_pen, seen)
+        done = token == eos
+        seen = seen.at[jnp.arange(B), token].set(True)
         tokens = [token]
         pos = S
         for _ in range(max_new_tokens - 1):
             rng, sub = jax.random.split(rng)
-            token, cache = decode_step(
+            token, cache, seen, done = decode_step(
                 self.params, cache, token[:, None],
-                jnp.full((B, 1), pos, jnp.int32), sub, temp)
+                jnp.full((B, 1), pos, jnp.int32), sub,
+                rep_pen, seen, done, eos, pad)
             tokens.append(token)
             pos += 1
-            if eos_token_id is not None and bool(
-                    jax.device_get((token == eos_token_id).all())):
+            if eos_token_id is not None and bool(jax.device_get(done.all())):
                 break
         return jnp.concatenate([input_ids] + [t[:, None] for t in tokens], axis=1)
 
 
-def _sample(logits, rng, temperature, top_k: int):
-    """Greedy / temperature / top-k sampling on fp32 logits (B, V);
-    ``top_k`` is static."""
+def _sample(logits, rng, temperature, top_k: int, top_p=1.0,
+            repetition_penalty=1.0, seen_mask=None):
+    """Greedy / temperature / top-k / top-p sampling with repetition
+    penalty on fp32 logits (B, V).  ``top_k`` is static.  ``top_p`` and
+    ``temperature`` may be python floats (static — dead branches like the
+    O(V log V) nucleus sort are dropped at trace time: a greedy decode
+    step compiles to penalty+argmax only) or traced scalars (the
+    per-request path in ``ContinuousBatcher``).
+
+    ``seen_mask`` (B, V) bool marks tokens already in the sequence; those
+    logits are divided (if positive) or multiplied (if negative) by the
+    penalty — the standard CTRL-style rule HF implements.
+    """
+    if seen_mask is not None:
+        pen = jnp.where(logits > 0, logits / repetition_penalty,
+                        logits * repetition_penalty)
+        logits = jnp.where(seen_mask, pen, logits)
     greedy = jnp.argmax(logits, axis=-1)
+    static_greedy = isinstance(temperature, (int, float)) and temperature <= 0.0
+    if static_greedy:
+        return greedy
     scaled = logits / jnp.maximum(temperature, 1e-6)
     if top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    static_full_p = isinstance(top_p, (int, float)) and \
+        (top_p >= 1.0 or top_p <= 0.0)
+    if not static_full_p:
+        # nucleus: keep the smallest prefix of descending-prob tokens whose
+        # mass reaches top_p (the top token always survives)
+        p = jnp.where(jnp.asarray(top_p) <= 0.0, 1.0, jnp.asarray(top_p))
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        kept = mass_before < p
+        thr = jnp.min(jnp.where(kept, sorted_desc, jnp.inf), axis=-1,
+                      keepdims=True)
+        scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
-    return jnp.where(temperature <= 0.0, greedy, sampled)
+    return jnp.where(jnp.asarray(temperature) <= 0.0, greedy, sampled)

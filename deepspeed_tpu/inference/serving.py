@@ -1,0 +1,231 @@
+"""Continuous-batching serve loop on the compiled decode step.
+
+The reference serves one static batch per ``InferenceEngine.forward``
+(``inference/engine.py:392``) — batching across requests is left to the
+caller.  Production decoding wants *continuous* batching (Orca-style):
+a fixed pool of KV-cache slots, requests admitted into free slots as
+others retire, one fused decode tick advancing every active slot.
+
+TPU-native realization: the per-slot decode step is the engine's B=1
+cached forward, ``jax.vmap``-ed over the slot dimension and jitted ONCE —
+each slot carries its own KV cache tree (including its own scalar
+``cache_index``, which vmap makes per-slot), position, RNG lane, sampling
+params, repetition-penalty ``seen`` mask, and ``done`` flag.  Admission
+runs the engine's compiled prefill at the prompt's exact length (XLA
+caches one executable per distinct length; bucket prompt lengths upstream
+if admission-compile cost matters) and scatters the resulting cache into
+the slot.  Retired slots keep emitting ``pad`` under ``done=True`` until
+reused, so the hot loop never recompiles or reshapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import InferenceEngine, _sample
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: np.ndarray                    # (S,) int32
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+
+
+@dataclasses.dataclass
+class _Active:
+    req: Request
+    emitted: List[int]
+
+
+class ContinuousBatcher:
+    """Slot-pool scheduler over an :class:`InferenceEngine`.
+
+    ``top_k`` and ``eos_token_id`` are pool-wide (``top_k`` is static in
+    the compiled sampler); temperature/top_p/repetition_penalty are
+    per-request.
+    """
+
+    def __init__(self, engine: InferenceEngine, n_slots: int = 4, *,
+                 top_k: int = 0, eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None, seed: int = 0):
+        if engine.params is None:
+            raise RuntimeError("engine has no parameters loaded")
+        self.engine = engine
+        self.n_slots = n_slots
+        self.top_k = int(top_k)
+        self.eos = -1 if eos_token_id is None else int(eos_token_id)
+        self.pad = int(pad_token_id if pad_token_id is not None
+                       else (eos_token_id if eos_token_id is not None else 0))
+        self.seed = seed
+        cfg = engine.decode_cfg
+        self._vocab = int(getattr(cfg, "padded_vocab_size", None)
+                          or cfg.vocab_size)
+
+        cache1 = engine.init_cache(1)
+        self._cache = jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(l, (n_slots,) + l.shape) + jnp.zeros_like(l),
+            cache1)
+        self._token = jnp.zeros((n_slots, 1, 1), jnp.int32)
+        self._pos = jnp.zeros((n_slots,), jnp.int32)
+        self._temp = jnp.zeros((n_slots,), jnp.float32)
+        self._top_p = jnp.ones((n_slots,), jnp.float32)
+        self._rep = jnp.ones((n_slots,), jnp.float32)
+        self._seen = jnp.zeros((n_slots, 1, self._vocab), bool)
+        self._done = jnp.ones((n_slots, 1), bool)      # free ⇒ done
+        self._slots: List[Optional[_Active]] = [None] * n_slots
+        self._queue: deque = deque()
+        self._tick_no = 0
+        self._next_uid = 0
+        self._finished: Dict[int, np.ndarray] = {}
+
+        decode_model = engine._decode_model
+        top_k_static = self.top_k
+        base_seed = seed
+
+        # params are an explicit broadcast argument (in_axes=None), NOT a
+        # closure capture: captured arrays serialize as literals in the
+        # compile payload (fatal over a remote-compile tunnel at 124M+)
+        def slot_step(params, cache, token, pos, slot_id, temp, top_p, rep,
+                      seen, done, tick, eos, pad):
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(base_seed), tick), slot_id)
+            out, vars_ = decode_model.apply(
+                {"params": params, "cache": cache}, token,
+                position_ids=jnp.full((1, 1), pos, jnp.int32),
+                mutable=["cache"])
+            logits = out["logits"][:, -1, :].astype(jnp.float32)   # (1, V)
+            nxt = _sample(logits, key, temp, top_k_static, top_p, rep, seen)
+            nxt = jnp.where(done, pad, nxt)
+            new_done = jnp.logical_or(done, nxt == eos)
+            seen = seen.at[jnp.arange(1), nxt].set(True)
+            return nxt, vars_["cache"], seen, new_done
+
+        self._step_fn = jax.jit(jax.vmap(
+            slot_step,
+            in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None)))
+
+        # admission: ONE jitted scatter of the new slot's cache + sampling
+        # state, with the slot index TRACED (a python-int index would bake
+        # into the program and recompile per slot — pathological on a
+        # tunneled device where each compile pays seconds of RTT)
+        def admit_fn(cache, token, pos, temp, top_p, rep, seen, done,
+                     cache1, logits, ids, uid, i, r_temp, r_top_p, r_rep):
+            key = jax.random.fold_in(jax.random.PRNGKey(base_seed), uid)
+            seen1 = engine._seen_mask_from(ids[None, :], self._vocab)
+            first = _sample(logits[:, -1, :].astype(jnp.float32), key,
+                            r_temp, top_k_static, r_top_p, r_rep, seen1)
+            seen1 = seen1.at[jnp.arange(1), first].set(True)
+
+            def put(big, small):
+                return jax.lax.dynamic_update_slice(
+                    big, small[None].astype(big.dtype),
+                    (i,) + (0,) * small.ndim)
+
+            cache = jax.tree_util.tree_map(put, cache, cache1)
+            token = put(token, first[:, None])
+            pos = put(pos, jnp.int32(ids.shape[0]))
+            temp = put(temp, r_temp)
+            top_p = put(top_p, r_top_p)
+            rep = put(rep, r_rep)
+            seen = put(seen, seen1)
+            done = put(done, first == jnp.int32(self.eos))
+            return cache, token, pos, temp, top_p, rep, seen, done, first
+
+        self._admit_fn = jax.jit(admit_fn)
+        self._set_done = jax.jit(lambda done, i: done.at[i, 0].set(True))
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
+               top_p: float = 1.0, repetition_penalty: float = 1.0) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.engine._gen_limit:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
+                f"exceeds the generation limit {self.engine._gen_limit}")
+        uid = self._next_uid
+        self._next_uid += 1
+        self._queue.append(Request(uid, prompt, max_new_tokens,
+                                   temperature, top_p, repetition_penalty))
+        return uid
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(s is not None for s in self._slots)
+
+    # ------------------------------------------------------------------
+    def _admit(self):
+        eng = self.engine
+        for i in range(self.n_slots):
+            if not self._queue or self._slots[i] is not None:
+                continue
+            req = self._queue.popleft()
+            ids = jnp.asarray(req.prompt)[None, :]
+            S = ids.shape[1]
+            cache1 = eng.init_cache(1)
+            positions = jnp.arange(S)[None, :]
+            logits, cache1 = eng._compiled_prefill(eng.params, cache1,
+                                                   ids, positions)
+            (self._cache, self._token, self._pos, self._temp, self._top_p,
+             self._rep, self._seen, self._done, first) = self._admit_fn(
+                self._cache, self._token, self._pos, self._temp,
+                self._top_p, self._rep, self._seen, self._done,
+                cache1, logits, jnp.asarray(req.prompt), req.uid, i,
+                req.temperature, req.top_p, req.repetition_penalty)
+            first_host = int(jax.device_get(first)[0])
+            done0 = first_host == self.eos or req.max_new_tokens <= 1
+            self._slots[i] = _Active(req, [first_host])
+            if done0:
+                self._retire(i)
+
+    def _retire(self, i: int):
+        act = self._slots[i]
+        self._finished[act.req.uid] = np.concatenate(
+            [act.req.prompt, np.asarray(act.emitted, np.int32)])
+        self._slots[i] = None
+        self._done = self._set_done(self._done, i)
+
+    # ------------------------------------------------------------------
+    def step(self) -> Dict[int, np.ndarray]:
+        """Admit queued requests, run ONE decode tick for every active
+        slot, retire finished ones.  Returns {uid: full token array} for
+        requests that completed during this call."""
+        before = set(self._finished)
+        self._admit()
+        if any(s is not None for s in self._slots):
+            slot_ids = jnp.arange(self.n_slots)
+            tok, self._cache, self._seen, done = self._step_fn(
+                self.engine.params, self._cache, self._token, self._pos,
+                slot_ids, self._temp, self._top_p, self._rep, self._seen,
+                self._done, jnp.int32(self._tick_no), jnp.int32(self.eos),
+                jnp.int32(self.pad))
+            self._tick_no += 1
+            self._token = tok[:, :, None]
+            self._pos = self._pos + 1
+            tok_h = np.asarray(jax.device_get(tok))[:, 0]
+            done_h = np.asarray(jax.device_get(done))[:, 0]
+            self._done = done
+            for i, act in enumerate(self._slots):
+                if act is None:
+                    continue
+                act.emitted.append(int(tok_h[i]))
+                if done_h[i] or len(act.emitted) >= act.req.max_new_tokens:
+                    self._retire(i)
+        new = {u: self._finished[u] for u in self._finished if u not in before}
+        return new
+
+    def run(self, prompts, **gen_kwargs) -> List[np.ndarray]:
+        """Convenience: submit every prompt, step until drained, return
+        outputs in submission order."""
+        uids = [self.submit(p, **gen_kwargs) for p in prompts]
+        while any(u not in self._finished for u in uids):
+            self.step()
+        return [self._finished[u] for u in uids]

@@ -24,6 +24,9 @@ import shlex
 import signal
 import subprocess
 import sys
+import tempfile
+import time
+from typing import Optional
 
 from ..utils.logging import logger
 
@@ -72,34 +75,131 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator_port", type=int, default=7777)
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
     p.add_argument("--ssh_port", type=int, default=22)
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="seconds without a worker heartbeat before the job "
+                        "is declared failed (0 = detector off)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the job this many times after a failure "
+                        "(workers resume via load_checkpoint)")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p
 
 
-def _launch_local_procs(args) -> int:
-    """Fork N local processes with rendezvous env (launch.py:90 analog)."""
+class HeartbeatMonitor:
+    """Failure detector over per-rank heartbeat files (the reference has
+    none — SURVEY.md §5 failure detection).  A worker is ``stale`` when
+    its file hasn't been touched for ``timeout`` seconds; files that never
+    appeared are only stale after a startup ``grace`` window (workers need
+    time to reach the training loop)."""
+
+    def __init__(self, files: list[str], timeout: float,
+                 grace: Optional[float] = None):
+        self.files = list(files)
+        self.timeout = timeout
+        self.grace = timeout * 3 if grace is None else grace
+        self.t0 = time.monotonic()
+
+    def stale(self) -> list[int]:
+        now = time.monotonic()
+        bad = []
+        for rank, path in enumerate(self.files):
+            try:
+                age = time.time() - os.path.getmtime(path)
+            except OSError:                      # not yet written
+                if now - self.t0 > self.grace:
+                    bad.append(rank)
+                continue
+            if age > self.timeout:
+                bad.append(rank)
+        return bad
+
+
+_TERM_GRACE_S = 10.0    # SIGTERM → SIGKILL escalation window (lets the
+                        # AsyncCheckpointManager SIGTERM-save finish)
+
+
+def _reap(procs, grace: float = _TERM_GRACE_S):
+    """terminate → wait(grace) → kill: a worker whose SIGTERM handler
+    never returns (or that is truly hung — the case heartbeat detection
+    exists for) must not deadlock the launcher."""
+    for pr in procs:
+        if pr.poll() is None:
+            pr.terminate()
+    deadline = time.monotonic() + grace
+    for pr in procs:
+        if pr.poll() is None:
+            try:
+                pr.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                pr.wait()
+
+
+def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
+    """Fork N local processes with rendezvous env (launch.py:90 analog);
+    with ``--heartbeat_timeout``, watch per-rank heartbeat files and kill
+    the job when a worker goes silent.  ``interrupted`` (a mutable cell)
+    is set when the operator SIGINT/SIGTERMs the launcher, so the restart
+    loop can tell shutdown from failure."""
     procs = []
     coord = f"{args.master_addr}:{args.coordinator_port}"
+    hb_dir = tempfile.mkdtemp(prefix="dstpu_hb_") \
+        if args.heartbeat_timeout > 0 else None
+    hb_files = []
     for pid_idx in range(args.num_processes):
         env = dict(os.environ,
                    DSTPU_COORDINATOR=coord,
                    DSTPU_NUM_PROCESSES=str(args.num_processes),
                    DSTPU_PROCESS_ID=str(pid_idx))
+        if hb_dir:
+            hb = os.path.join(hb_dir, f"hb_{pid_idx}")
+            env["DSTPU_HEARTBEAT_FILE"] = hb
+            hb_files.append(hb)
         cmd = [sys.executable, args.user_script] + args.user_args
         logger.info(f"launching process {pid_idx}: {' '.join(map(shlex.quote, cmd))}")
         procs.append(subprocess.Popen(cmd, env=env))
 
-    def _kill(signum, frame):  # SIGINT/SIGTERM fan-out (launch.py:176)
+    def _on_signal(signum, frame):  # operator shutdown (launch.py:176)
+        if interrupted is not None:
+            interrupted.append(signum)
         for pr in procs:
-            pr.terminate()
+            if pr.poll() is None:
+                pr.terminate()
 
-    signal.signal(signal.SIGINT, _kill)
-    signal.signal(signal.SIGTERM, _kill)
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    monitor = HeartbeatMonitor(hb_files, args.heartbeat_timeout) \
+        if hb_files else None
     rc = 0
-    for pr in procs:
-        pr.wait()
-        rc = rc or pr.returncode
+    try:
+        while True:
+            states = [pr.poll() for pr in procs]
+            if all(s is not None for s in states):
+                rc = next((s for s in states if s), 0)
+                break
+            if any(s not in (None, 0) for s in states):
+                dead = [i for i, s in enumerate(states) if s not in (None, 0)]
+                logger.error(f"worker(s) {dead} exited nonzero; killing job")
+                rc = next(s for s in states if s not in (None, 0))
+                _reap(procs)
+                break
+            if monitor is not None:
+                # ranks that already exited cleanly stop beating legitimately
+                bad = [r for r in monitor.stale() if states[r] is None]
+                if bad:
+                    logger.error(f"worker(s) {bad} heartbeat stale "
+                                 f"(> {args.heartbeat_timeout}s); killing job")
+                    _reap(procs)
+                    rc = 1
+                    break
+            time.sleep(0.2)
+        _reap(procs)
+    finally:
+        if hb_dir:
+            import shutil
+
+            shutil.rmtree(hb_dir, ignore_errors=True)
     return rc
 
 
@@ -131,8 +231,30 @@ def main(argv=None) -> int:
         args.user_args = args.user_args[1:]
     if args.hostfile:
         return _launch_hostfile(args)
-    if args.num_processes > 1:
-        return _launch_local_procs(args)
+    if args.num_processes > 1 or args.heartbeat_timeout > 0 \
+            or args.max_restarts > 0:
+        if 0 < args.heartbeat_timeout < 2.0:
+            raise ValueError(
+                "--heartbeat_timeout must be >= 2s: workers throttle "
+                "heartbeats to one write per second")
+        # restart loop: recovery = relaunch + load_checkpoint (the
+        # reference's recovery model, automated; engine resumes from the
+        # `latest` tag when the script calls load_checkpoint)
+        attempts = args.max_restarts + 1
+        for attempt in range(attempts):
+            interrupted: list = []
+            rc = _launch_local_procs(args, interrupted)
+            if rc == 0:
+                return 0
+            if interrupted:
+                # operator shutdown (Ctrl-C / SIGTERM) is not a failure —
+                # never auto-restart over the user's intent
+                logger.info("job interrupted by operator; not restarting")
+                return rc
+            if attempt < attempts - 1:
+                logger.warning(f"job failed (rc={rc}); restart "
+                               f"{attempt + 1}/{args.max_restarts}")
+        return rc
     # single process: exec in place (the common TPU case — one proc/host)
     os.execv(sys.executable, [sys.executable, args.user_script] + args.user_args)
 

@@ -52,6 +52,10 @@ class GPT2Config:
                                        # scheduling barrier)
     vocab_pad_multiple: int = 128      # MXU/TP-friendly vocab padding
     decode: bool = False               # KV-cache autoregressive mode
+    # flash-kernel tiling knobs (autotuner search space; None = kernel
+    # defaults, see ops/pallas/flash_attention.py)
+    flash_block: Optional[tuple] = None          # (block_q, block_k)
+    flash_heads_per_program: Optional[int] = None
     # Mixture-of-Experts FFN (reference deepspeed/moe usage: MoE replaces
     # the MLP).  With scan_layers the stack is homogeneous, so MoE applies
     # to EVERY block (use use_residual=True for the PR-MoE dense+MoE mix).
@@ -192,10 +196,16 @@ class SelfAttention(nn.Module):
         dropout_rng = None
         if cfg.attn_pdrop > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
+        flash_opts = {}
+        if cfg.flash_block is not None:
+            flash_opts["block_q"], flash_opts["block_k"] = cfg.flash_block
+        if cfg.flash_heads_per_program is not None:
+            flash_opts["heads_per_program"] = cfg.flash_heads_per_program
         y = dot_product_attention(
             q, k, v, causal=True, mask=attn_mask,
             dropout_rate=0.0 if deterministic else cfg.attn_pdrop,
-            dropout_rng=dropout_rng, impl=cfg.attn_impl)
+            dropout_rng=dropout_rng, impl=cfg.attn_impl,
+            flash_opts=flash_opts or None)
         y = y.reshape(B, S, E)
         out = _dense(y, E, ("heads", "embed"), cfg=cfg, name="c_proj", module=self,
                      init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)

@@ -50,6 +50,7 @@ def dot_product_attention(
     dropout_rng: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     impl: str = "auto",
+    flash_opts: Optional[dict] = None,
 ) -> jax.Array:
     """Multi-head scaled dot-product attention; returns ``(B, S, H, D)``.
 
@@ -62,7 +63,8 @@ def dot_product_attention(
         return _sp_attention(q, k, v, causal=causal, scale=scale, kind=impl)
     impl = _pick_impl(impl, q)
     if impl == "flash" and bias is None and mask is None and dropout_rate == 0.0:
-        out = _flash_spmd(q, k, v, causal=causal, scale=scale)
+        out = _flash_spmd(q, k, v, causal=causal, scale=scale,
+                          flash_opts=flash_opts)
         if out is not None:
             return out
     return _jnp_attention(q, k, v, causal=causal, bias=bias, mask=mask,
@@ -70,7 +72,7 @@ def dot_product_attention(
                           scale=scale)
 
 
-def _flash_spmd(q, k, v, *, causal, scale, interpret=False):
+def _flash_spmd(q, k, v, *, causal, scale, interpret=False, flash_opts=None):
     """Flash kernel, SPMD-correct: on a multi-device mesh the pallas_call is
     opaque to the partitioner (XLA would gather operands), so shard_map it
     over the batch (dp/fsdp/ep) and head (tp) axes — attention is
@@ -88,7 +90,7 @@ def _flash_spmd(q, k, v, *, causal, scale, interpret=False):
     if verdict is None:
         return None
     kern = partial(flash_attention, causal=causal, scale=scale,
-                   interpret=interpret)
+                   interpret=interpret, **(flash_opts or {}))
     try:
         if verdict == "direct":
             return kern(q, k, v)

@@ -6,9 +6,16 @@ one new query attends a growing KV history under triangular masking).  On
 TPU the decode step is one program per batch element: the query rows and
 the cached K/V panel ``(S, H, D)`` live in VMEM (legal blocks: the last two
 dims are the full array dims), scores are masked to the live prefix
-(``length``), and per-head (1, S) x (S, D) matmuls ride the MXU.  The
+(``lengths[b]``), and per-head (1, S) x (S, D) matmuls ride the MXU.  The
 cache is read from HBM exactly once, in its native model layout — no
 transpose copy.
+
+``length`` may be a scalar (whole batch at one position — the static-batch
+``generate`` path) or per-row ``(B,)`` (continuous batching, where every
+slot sits at its own depth).  The op carries a ``custom_vmap`` rule that
+folds any vmapped axis into the kernel's batch grid, so a slot-vmapped
+decode step (``inference/serving.py``) runs ONE batched kernel instead of
+tripping Pallas' auto-batching on the SMEM operand.
 
 Callers should keep the cache panel within VMEM (see ``fits_vmem``);
 the model dispatch falls back to the XLA path otherwise.
@@ -27,7 +34,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 
-_VMEM_BUDGET_BYTES = 8 * 1024 * 1024   # half of ~16MB/core for the K+V panel
+# Mosaic double-buffers each program's input blocks across grid steps, so
+# the K+V panels cost 2x their size in scoped VMEM (~16MB/core); leave the
+# other half for q/out/f32 head slices.  Measured: fp32 (1024,12,64)
+# panels (2x6.3MB after double-buffering) overflow by 440KB.
+_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
 
 
 def fits_vmem(s: int, h: int, d: int, itemsize: int) -> bool:
@@ -35,7 +46,7 @@ def fits_vmem(s: int, h: int, d: int, itemsize: int) -> bool:
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads):
-    L = len_ref[0]
+    L = len_ref[pl.program_id(0)]
     for h in range(n_heads):
         q = q_ref[0, 0, h].astype(jnp.float32)[None, :] * scale      # (1, D)
         k = k_ref[0, :, h].astype(jnp.float32)                       # (S, D)
@@ -52,6 +63,50 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads):
         o_ref[0, 0, h] = o[0].astype(o_ref.dtype)
 
 
+def _pallas_decode(q, k_cache, v_cache, lengths, *, scale, interpret):
+    B, _, H, D = q.shape
+    S = k_cache.shape[1]
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, n_heads=H),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # lengths (B,), whole
+            pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+        interpret=interpret,
+    )(lengths, q, k_cache, v_cache)
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_op(scale: float, interpret: bool):
+    @jax.custom_batching.custom_vmap
+    def call(q, k_cache, v_cache, lengths):
+        return _pallas_decode(q, k_cache, v_cache, lengths,
+                              scale=scale, interpret=interpret)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, q, k_cache, v_cache, lengths):
+        def ensure(x, was):
+            return x if was else jnp.broadcast_to(
+                x[None], (axis_size,) + x.shape)
+
+        q, k_cache, v_cache, lengths = (
+            ensure(a, w) for a, w in
+            zip((q, k_cache, v_cache, lengths), in_batched))
+        N, B = q.shape[0], q.shape[1]
+        out = call(q.reshape((N * B,) + q.shape[2:]),
+                   k_cache.reshape((N * B,) + k_cache.shape[2:]),
+                   v_cache.reshape((N * B,) + v_cache.shape[2:]),
+                   lengths.reshape(N * B))
+        return out.reshape((N, B) + out.shape[1:]), True
+
+    return call
+
+
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      length, *, scale: Optional[float] = None,
                      interpret: bool = False) -> jax.Array:
@@ -60,26 +115,15 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     ``q``: ``(B, 1, H, D)`` — the new token's query.
     ``k_cache``/``v_cache``: ``(B, S_max, H, D)`` — cache AFTER appending
     the new K/V (model cache layout).
-    ``length``: scalar int — number of valid cache slots (``cur + 1``).
+    ``length``: int scalar or ``(B,)`` — number of valid cache slots per
+    row (``cur + 1``).
 
     Returns ``(B, 1, H, D)``.
     """
     B, _, H, D = q.shape
-    S = k_cache.shape[1]
     if scale is None:
         scale = D ** -0.5
-    length = jnp.asarray(length, jnp.int32).reshape(1)
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, n_heads=H),
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
-            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
-            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
-        interpret=interpret,
-    )(length, q, k_cache, v_cache)
-    return out
+    lengths = jnp.broadcast_to(
+        jnp.asarray(length, jnp.int32).reshape(-1), (B,))
+    return _decode_op(float(scale), bool(interpret))(
+        q, k_cache, v_cache, lengths)

@@ -154,6 +154,111 @@ class TrainSchedule(PipeSchedule):
         yield [ReduceGrads(), OptimizerStep()]
 
 
+class InterleavedTrainSchedule(PipeSchedule):
+    """Interleaved 1F1B with virtual stages (Megatron-style; NOT in the
+    reference at v0.6.6 — its ``TrainSchedule`` is plain 1F1B).  Each
+    physical stage hosts ``virtual_stages`` model chunks: chunk ``v`` on
+    stage ``s`` holds global chunk ``v*S + s``.  The warmup depth grows to
+    cover all chunks, but each chunk is ``V×`` smaller, so the pipeline
+    bubble shrinks from ``(S-1)/M`` to ``(S-1)/(V·M)`` of total work.
+
+    Instructions carry ``(micro_batch, chunk)`` via ``micro_batch_id`` =
+    ``mb * V + chunk`` packing; use :meth:`unpack` to split.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int,
+                 virtual_stages: int = 2):
+        super().__init__(micro_batches, stages, stage_id)
+        if virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got {virtual_stages}")
+        if micro_batches % stages != 0:
+            raise ValueError(
+                f"interleaved schedule requires micro_batches ({micro_batches}) "
+                f"divisible by stages ({stages})")
+        self.virtual_stages = virtual_stages
+
+    def unpack(self, packed: int):
+        return packed // self.virtual_stages, packed % self.virtual_stages
+
+    def _pack(self, mb: int, chunk: int) -> int:
+        return mb * self.virtual_stages + chunk
+
+    def _warmup_depth(self, sid: int) -> int:
+        return min(self.micro_batches * self.virtual_stages,
+                   (self.stages - sid - 1) * 2
+                   + (self.virtual_stages - 1) * self.stages)
+
+    def num_pipe_buffers(self) -> int:
+        """Live (mb, chunk) activations peak at the warmup depth plus the
+        one forward issued alongside each steady-state backward."""
+        total = self.micro_batches * self.virtual_stages
+        return min(total, self._warmup_depth(self.stage_id) + 1)
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Per-phase bubble relative to useful chunk-ticks."""
+        return (self.stages - 1) / (self.virtual_stages * self.micro_batches)
+
+    def _work_orders(self):
+        """Global (forward_order, backward_order) of (mb, chunk) chunk-ticks
+        for this stage.  Megatron ordering: microbatches are walked in
+        groups of S; each group finishes chunk v everywhere before chunk
+        v+1 starts."""
+        M, S, V = self.micro_batches, self.stages, self.virtual_stages
+        fwd = []
+        for g in range(M // S):          # microbatch group
+            for v in range(V):           # chunk within group
+                for m in range(g * S, (g + 1) * S):
+                    fwd.append((m, v))
+        bwd = [(m, V - 1 - v) for (m, v) in fwd]
+        return fwd, bwd
+
+    def steps(self):
+        M, S, sid, V = self.micro_batches, self.stages, self.stage_id, \
+            self.virtual_stages
+        fwd_order, bwd_order = self._work_orders()
+        total = len(fwd_order)
+        # warmup chunk-ticks (Megatron formula): enough forwards in flight
+        # to cover the round trip across all virtual stages
+        warmup = self._warmup_depth(sid)
+        fi = bi = 0
+
+        def fwd_cmds(mb, chunk):
+            cmds = []
+            first = chunk == 0 and sid == 0
+            cmds.append(LoadMicroBatch(self._pack(mb, chunk)) if first
+                        else RecvActivation(self._pack(mb, chunk)))
+            cmds.append(ForwardPass(self._pack(mb, chunk)))
+            last = chunk == V - 1 and sid == S - 1
+            if not last:
+                cmds.append(SendActivation(self._pack(mb, chunk)))
+            return cmds
+
+        def bwd_cmds(mb, chunk):
+            cmds = []
+            last = chunk == V - 1 and sid == S - 1
+            if not last:
+                cmds.append(RecvGrad(self._pack(mb, chunk)))
+            cmds.append(BackwardPass(self._pack(mb, chunk)))
+            first = chunk == 0 and sid == 0
+            if not first:
+                cmds.append(SendGrad(self._pack(mb, chunk)))
+            return cmds
+
+        for _ in range(warmup):
+            yield fwd_cmds(*fwd_order[fi])
+            fi += 1
+        while bi < total:
+            cmds = []
+            if fi < total:
+                cmds += fwd_cmds(*fwd_order[fi])
+                fi += 1
+            cmds += bwd_cmds(*bwd_order[bi])
+            bi += 1
+            yield cmds
+        yield [ReduceGrads(), OptimizerStep()]
+
+
 class InferenceSchedule(PipeSchedule):
     """Forward-only wave (reference ``schedule.py`` InferenceSchedule)."""
 

@@ -39,20 +39,8 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                    client_state: Optional[dict] = None) -> str:
-    """Write a sharded checkpoint under ``save_dir/tag`` + ``latest`` tag."""
-    if tag is None:
-        tag = f"global_step{engine.global_steps}"
-    ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
-    os.makedirs(ckpt_dir, exist_ok=True)
-
-    ckptr = _checkpointer()
-    state_path = os.path.join(ckpt_dir, MODULE_DIR)
-    ckptr.save(state_path, engine.state, force=True)
-    ckptr.wait_until_finished()
-
-    meta = {
+def _build_meta(engine, client_state: Optional[dict]) -> dict:
+    return {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
         "micro_steps": engine.micro_steps,
@@ -62,6 +50,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "dstpu_version": 1,
     }
+
+
+def _publish_meta(meta: dict, save_dir: str, ckpt_dir: str, tag: str) -> None:
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, ENGINE_STATE_FILE), "w") as fh:
             json.dump(meta, fh, indent=2)
@@ -69,8 +60,123 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # checkpoint (reference writes `latest` after all ranks finish)
         with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
             fh.write(tag)
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[dict] = None) -> str:
+    """Write a sharded checkpoint under ``save_dir/tag`` + ``latest`` tag."""
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    from ..utils.heartbeat import beat
+
+    ckptr = _checkpointer()
+    state_path = os.path.join(ckpt_dir, MODULE_DIR)
+    beat(min_interval_s=0.0)   # a long synchronous save must not look like
+    ckptr.save(state_path, engine.state, force=True)   # a hung worker
+    ckptr.wait_until_finished()
+    beat(min_interval_s=0.0)
+    _publish_meta(_build_meta(engine, client_state), save_dir, ckpt_dir, tag)
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
     return ckpt_dir
+
+
+class AsyncCheckpointManager:
+    """Preemption-aware async checkpointing (beyond the reference, whose
+    recovery story is relaunch + ``load_checkpoint``; ROADMAP fault-
+    tolerance item).
+
+    - ``save()`` hands the device state to orbax's AsyncCheckpointer: the
+      host copy + write happen on a background thread while training
+      continues.  The ``latest`` tag and engine metadata are written only
+      when the async commit finishes (on the next ``save()``/``step()``/
+      ``wait()``), so a crash mid-write never points at a torn checkpoint.
+    - ``install_sigterm=True`` registers a SIGTERM handler (the TPU/GKE
+      preemption signal): the handler only sets ``preempted``; the next
+      ``step()`` call performs a final SYNCHRONOUS save and returns its
+      path, letting the training loop exit cleanly within the grace
+      period.
+    """
+
+    def __init__(self, engine, save_dir: str, interval_steps: int = 0,
+                 install_sigterm: bool = True):
+        import orbax.checkpoint as ocp
+
+        self.engine = engine
+        self.save_dir = save_dir
+        self.interval_steps = interval_steps
+        self.preempted = False
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        self._pending: Optional[tuple] = None   # (ckpt_dir, tag, meta-snapshot)
+        self._prev_handler = None
+        if install_sigterm:
+            import signal
+
+            def _on_sigterm(signum, frame):
+                self.preempted = True
+                logger.warning("SIGTERM received: checkpoint at next step()")
+
+            self._prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    # ------------------------------------------------------------------
+    def _finalize(self):
+        """Block on any in-flight save, then publish its meta + tag."""
+        if self._pending is None:
+            return
+        from ..utils.heartbeat import beat
+
+        beat(min_interval_s=0.0)
+        self._ckptr.wait_until_finished()
+        beat(min_interval_s=0.0)
+        ckpt_dir, tag, meta = self._pending
+        self._pending = None
+        _publish_meta(meta, self.save_dir, ckpt_dir, tag)
+        log_dist(f"committed async checkpoint {ckpt_dir}", ranks=[0])
+
+    def save(self, tag: Optional[str] = None, sync: bool = False,
+             client_state: Optional[dict] = None) -> str:
+        import orbax.checkpoint as ocp
+
+        self._finalize()
+        if tag is None:
+            tag = f"global_step{self.engine.global_steps}"
+        ckpt_dir = os.path.abspath(os.path.join(self.save_dir, tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+        state_path = os.path.join(ckpt_dir, MODULE_DIR)
+        self._ckptr.save(state_path,
+                         args=ocp.args.StandardSave(self.engine.state),
+                         force=True)
+        # snapshot the counters NOW — by commit time the engine has moved on
+        self._pending = (ckpt_dir, tag, _build_meta(self.engine, client_state))
+        if sync:
+            self._finalize()
+        return ckpt_dir
+
+    def step(self, client_state: Optional[dict] = None) -> Optional[str]:
+        """Call once per training step.  Saves on the interval; on
+        preemption performs a final synchronous save."""
+        if self.preempted:
+            path = self.save(sync=True, client_state=client_state)
+            return path
+        if self.interval_steps and \
+                self.engine.global_steps % self.interval_steps == 0 and \
+                self.engine.global_steps > 0:
+            return self.save(client_state=client_state)
+        return None
+
+    def wait(self):
+        self._finalize()
+
+    def close(self):
+        self._finalize()
+        self._ckptr.close()
+        if self._prev_handler is not None:
+            import signal
+
+            signal.signal(signal.SIGTERM, self._prev_handler)
+            self._prev_handler = None
 
 
 def _resolve_tag(load_dir: str, tag: Optional[str]) -> str:

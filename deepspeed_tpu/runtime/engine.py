@@ -730,6 +730,9 @@ class Engine:
         global micro-batches from it (reference ``pipe/engine.py:302``
         semantics).
         """
+        from ..utils.heartbeat import beat
+
+        beat()   # launcher failure detector (no-op unless launched with one)
         self._require_state()
         if batch is None:
             if data_iter is None:
@@ -780,6 +783,9 @@ class Engine:
         return metrics["loss"]
 
     def eval_batch(self, batch):
+        from ..utils.heartbeat import beat
+
+        beat()
         self._require_state()
         return self._compiled_eval_step(self._state.params, self._shard_batch(batch))
 

@@ -80,6 +80,20 @@ def split_param_tree(params: dict, mp_size: int, axes_tree: dict,
         for r in range(mp_size)]
 
 
+def _load_npz_tree(path: str) -> dict:
+    """Read a ``key/sub/leaf``-flattened ``.npz`` back into a nested dict."""
+    with np.load(path, allow_pickle=True) as z:
+        flat = {k: z[k] for k in z.files}
+    tree: dict = {}
+    for key, val in flat.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
 class SDLoaderFactory:
     """Dispatch by checkpoint descriptor (reference :17)."""
 
@@ -109,18 +123,7 @@ class MegatronSDLoader:
         self.axes_tree = axes_tree
 
     def _load_one(self, path: str) -> dict:
-        import jax
-
-        with np.load(path, allow_pickle=True) as z:
-            flat = {k: z[k] for k in z.files}
-        tree: dict = {}
-        for key, val in flat.items():
-            node = tree
-            parts = key.split("/")
-            for p in parts[:-1]:
-                node = node.setdefault(p, {})
-            node[parts[-1]] = val
-        return tree
+        return _load_npz_tree(path)
 
     def load(self, mp_world_size: int, mp_rank: int, axes_tree=None) -> dict:
         """Full merge then split to the requested degree — handles both
@@ -133,6 +136,122 @@ class MegatronSDLoader:
         if mp_world_size == 1:
             return full
         return split_param_tree(full, mp_world_size, axes_tree)[mp_rank]
+
+
+def pp_axis_for(logical_names: Sequence[Optional[str]]) -> Optional[int]:
+    """Which dim of a tensor is the stacked-layer (pipeline) dim — the
+    ``layers`` logical axis the engine shards over ``pp``."""
+    for d, name in enumerate(logical_names):
+        if name == "layers":
+            return d
+    return None
+
+
+def merge_pp_stage_trees(stage_trees: list[dict], axes_tree: dict) -> dict:
+    """Concatenate per-pipeline-stage trees into one: layer-stacked leaves
+    concat on their ``layers`` dim, shared (replicated-across-pp) leaves
+    take stage 0's copy."""
+    import jax
+
+    def merge(axes, *leaves):
+        axis = pp_axis_for(axes)
+        if axis is None:
+            return leaves[0]
+        return np.concatenate(leaves, axis=axis)
+
+    return jax.tree_util.tree_map(merge, axes_tree, *stage_trees,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+
+
+def split_pp_stage_tree(params: dict, pp_size: int, axes_tree: dict) -> list[dict]:
+    """Partition the stacked-layer dim uniformly into ``pp_size`` stages;
+    shared leaves are replicated to every stage."""
+    import jax
+
+    def split(axes, leaf):
+        axis = pp_axis_for(axes)
+        if axis is None:
+            return [leaf] * pp_size
+        if leaf.shape[axis] % pp_size:
+            raise ValueError(
+                f"layer dim size {leaf.shape[axis]} not divisible by "
+                f"pp_size {pp_size}")
+        return list(np.split(leaf, pp_size, axis=axis))
+
+    per_leaf = jax.tree_util.tree_map(split, axes_tree, params,
+                                      is_leaf=lambda x: isinstance(x, tuple))
+    return [jax.tree_util.tree_map(lambda s: s[r], per_leaf,
+                                   is_leaf=lambda x: isinstance(x, list))
+            for r in range(pp_size)]
+
+
+class UniversalSDLoader:
+    """Any-to-any topology reshard of per-rank checkpoint file grids —
+    the "universal checkpoint" the reference v0.6.6 predates (its
+    ``deepspeed/checkpoint/`` holds only constants; MP-degree-only
+    resharding lives in ``MegatronSDLoader``, reference
+    ``state_dict_factory.py:195``).
+
+    ``ckpt_grid[pp_rank][tp_rank]`` names one ``.npz`` tree per saved
+    rank.  ``load`` merges the full logical tree (TP concat within each
+    stage by the TP rules, then layer-dim concat across stages) and
+    re-splits to ANY target (pp_size × tp_size) grid — including 1×1,
+    which recovers the consolidated state dict.
+    """
+
+    def __init__(self, ckpt_grid: list[list[str]],
+                 axes_tree: Optional[dict] = None, rules: dict = TP_RULES):
+        widths = {len(row) for row in ckpt_grid}
+        if len(widths) != 1:
+            raise ValueError("ragged checkpoint grid: every pp row must "
+                             "have the same tp width")
+        self.ckpt_grid = [list(row) for row in ckpt_grid]
+        self.axes_tree = axes_tree
+        self.rules = rules
+        self._full_cache: Optional[tuple] = None   # (id(axes_tree), tree)
+
+    def _full_tree(self, axes_tree: dict) -> dict:
+        # merge once, serve every target rank from it — a (pp×tp) restore
+        # calls load() pp*tp times and must not re-read the whole
+        # checkpoint each time
+        if self._full_cache is not None and \
+                self._full_cache[0] == id(axes_tree):
+            return self._full_cache[1]
+        stages = []
+        for row in self.ckpt_grid:
+            shards = [_load_npz_tree(p) for p in row]
+            stages.append(merge_param_trees(shards, axes_tree, self.rules)
+                          if len(shards) > 1 else shards[0])
+        full = merge_pp_stage_trees(stages, axes_tree) \
+            if len(stages) > 1 else stages[0]
+        self._full_cache = (id(axes_tree), full)
+        return full
+
+    def load(self, tp_size: int, tp_rank: int, pp_size: int = 1,
+             pp_rank: int = 0, axes_tree: Optional[dict] = None) -> dict:
+        axes_tree = axes_tree or self.axes_tree
+        if axes_tree is None:
+            raise ValueError("axes_tree (logical axis names per leaf) required")
+        full = self._full_tree(axes_tree)
+        stage = full if pp_size == 1 else \
+            split_pp_stage_tree(full, pp_size, axes_tree)[pp_rank]
+        if tp_size == 1:
+            return stage
+        return split_param_tree(stage, tp_size, axes_tree, self.rules)[tp_rank]
+
+
+def save_universal_shards(params: dict, axes_tree: dict, tp_size: int,
+                          pp_size: int, out_dir: str) -> list[list[str]]:
+    """Write a (pp × tp) grid of ``.npz`` rank files; inverse of
+    :meth:`UniversalSDLoader.load` at the same degrees."""
+    grid = []
+    for pp_rank, stage in enumerate(
+            split_pp_stage_tree(params, pp_size, axes_tree)
+            if pp_size > 1 else [params]):
+        row = save_megatron_shards(stage, axes_tree, tp_size, out_dir,
+                                   prefix=f"pp_{pp_rank:02d}_mp_rank")
+        grid.append(row)
+    return grid
 
 
 def save_megatron_shards(params: dict, axes_tree: dict, mp_size: int,

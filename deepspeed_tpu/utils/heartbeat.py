@@ -1,0 +1,35 @@
+"""Worker-side heartbeat for the launcher's failure detector.
+
+The reference has NO in-job failure detection (SURVEY.md §5): its launcher
+only propagates signals (``launcher/launch.py:176``) and recovery is
+manual relaunch.  Here each worker touches a per-rank heartbeat file
+(path injected by the launcher via ``DSTPU_HEARTBEAT_FILE``) from the
+training loop; the launcher declares a worker dead when its file goes
+stale and restarts the job (ROADMAP fault-tolerance item — beyond-
+reference capability).
+
+``beat()`` is throttled to at most one write per second, so calling it
+every train step is free.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+ENV_VAR = "DSTPU_HEARTBEAT_FILE"
+_last_beat = 0.0
+
+
+def beat(min_interval_s: float = 1.0) -> bool:
+    """Touch the heartbeat file if configured; returns True if touched."""
+    global _last_beat
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return False
+    now = time.monotonic()
+    if now - _last_beat < min_interval_s:
+        return False
+    _last_beat = now
+    with open(path, "w") as fh:
+        fh.write(str(time.time()))
+    return True

@@ -77,6 +77,46 @@ def test_autotuner_kernel_options_space():
         mesh_mod.set_mesh(None)
 
 
+def test_autotuner_flash_knobs_probed_and_carried():
+    """Explicit flash tiling kernel_options probe cleanly and the winner's
+    override lands in model_overrides (on CPU the flash kernel itself
+    can't engage, but the config plumbing is backend-independent)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    mesh_mod.set_mesh(None)
+    try:
+        model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", dtype=jnp.float32))
+        tuner = Autotuner(model, {"train_micro_batch_size_per_gpu": 1},
+                          micro_batches=[1], zero_stages=[1],
+                          remat_options=[False],
+                          kernel_options=[{"flash_block": (256, 256)},
+                                          {"flash_heads_per_program": 2}])
+        cfg = tuner.tune()
+        assert all(r.error is None for r in tuner.results), \
+            [r.error for r in tuner.results]
+        assert cfg["model_overrides"] in (
+            {"flash_block": (256, 256)}, {"flash_heads_per_program": 2})
+        # the override reconfigures the model when fed back to initialize()
+        import deepspeed_tpu
+
+        mesh_mod.set_mesh(None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "model_overrides": dict(cfg["model_overrides"])})
+        mo = cfg["model_overrides"]
+        for k, v in mo.items():
+            got = getattr(engine.model.cfg, k)
+            assert got == v or got == tuple(v)
+    finally:
+        mesh_mod.set_mesh(None)
+
+
 def test_model_overrides_applied_by_engine():
     """An autotuned config with model_overrides reconfigures the model."""
     import jax.numpy as jnp

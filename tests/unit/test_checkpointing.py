@@ -98,3 +98,61 @@ def test_missing_tag_raises(tmp_path):
     e = make_engine()
     with pytest.raises(FileNotFoundError):
         e.load_checkpoint(str(tmp_path))
+
+
+# ---------------- preemption-aware async checkpointing ----------------
+
+def test_async_checkpoint_manager_roundtrip(tmp_path):
+    import os
+
+    from deepspeed_tpu.runtime.checkpointing import AsyncCheckpointManager
+
+    e1 = make_engine()
+    for i in range(2):
+        e1.train_batch(batch(e1, i))
+    mgr = AsyncCheckpointManager(e1, str(tmp_path), install_sigterm=False)
+    mgr.save()
+    # `latest` is only published once the async write commits
+    mgr.wait()
+    assert (tmp_path / "latest").read_text() == "global_step2"
+    mgr.close()
+
+    e2 = make_engine()
+    e2.init_params()
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 2
+    trees_equal(e1.state.params, e2.state.params)
+
+
+def test_async_checkpoint_interval_and_preemption(tmp_path):
+    import os
+    import signal
+
+    from deepspeed_tpu.runtime.checkpointing import AsyncCheckpointManager
+
+    e = make_engine()
+    mgr = AsyncCheckpointManager(e, str(tmp_path), interval_steps=2,
+                                 install_sigterm=True)
+    try:
+        saves = []
+        for i in range(4):
+            e.train_batch(batch(e, i))
+            p = mgr.step()
+            if p:
+                saves.append(p)
+        assert len(saves) == 2          # steps 2 and 4
+        # simulate the TPU preemption signal
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert mgr.preempted
+        e.train_batch(batch(e, 9))
+        final = mgr.step()
+        assert final and final.endswith("global_step5")
+        # sync save: already committed, latest points at it
+        assert (tmp_path / "latest").read_text() == "global_step5"
+    finally:
+        mgr.close()
+
+    e2 = make_engine()
+    e2.init_params()
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 5

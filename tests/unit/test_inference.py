@@ -79,6 +79,93 @@ def test_generate_sampling_runs():
     assert (out[:, 3:] < 512).all()
 
 
+def test_sample_top_p_restricts_to_nucleus():
+    from deepspeed_tpu.inference.engine import _sample
+    logits = jnp.asarray([[10.0, 9.0] + [-10.0] * 6])
+    # token0 holds ~73% of the mass; top_p=0.5 keeps only token0
+    for seed in range(5):
+        tok = _sample(logits, jax.random.PRNGKey(seed), jnp.float32(1.0),
+                      0, jnp.float32(0.5), jnp.float32(1.0), None)
+        assert int(tok[0]) == 0
+    # top_p=1.0 can sample token1 too
+    seen = {int(_sample(logits, jax.random.PRNGKey(s), jnp.float32(1.0),
+                        0, jnp.float32(1.0), jnp.float32(1.0), None)[0])
+            for s in range(40)}
+    assert seen >= {0, 1}
+
+
+def test_sample_repetition_penalty_demotes_seen():
+    from deepspeed_tpu.inference.engine import _sample
+    logits = jnp.asarray([[5.0, 4.9, 1.0, 0.5]])
+    seen = jnp.zeros((1, 4), bool).at[0, 0].set(True)
+    # greedy without penalty picks 0; with a strong penalty on seen 0 → 1
+    plain = _sample(logits, jax.random.PRNGKey(0), jnp.float32(0.0),
+                    0, jnp.float32(1.0), jnp.float32(1.0), seen)
+    pen = _sample(logits, jax.random.PRNGKey(0), jnp.float32(0.0),
+                  0, jnp.float32(1.0), jnp.float32(10.0), seen)
+    assert int(plain[0]) == 0 and int(pen[0]) == 1
+
+
+def test_generate_per_sequence_eos_padding():
+    """After a sequence emits EOS it must be frozen to pad_token_id while
+    the other batch rows keep generating."""
+    eng = _tiny_engine()
+    ids = np.random.default_rng(5).integers(0, 512, size=(2, 4)).astype(np.int32)
+    free = np.asarray(eng.generate(ids, max_new_tokens=8))
+    # pick the token row 0 emits second, use it as "EOS"
+    eos = int(free[0, 5])
+    pad = 511
+    out = np.asarray(eng.generate(ids, max_new_tokens=8, eos_token_id=eos,
+                                  pad_token_id=pad))
+    gen = out[:, 4:]
+    for b in range(2):
+        hits = np.where(gen[b] == eos)[0]
+        if hits.size:
+            assert (gen[b, hits[0] + 1:] == pad).all()
+    # row 0 definitely hit it at step 1
+    assert (gen[0, 2:] == pad).all() or eos == pad
+
+
+def test_generate_top_p_penalty_runs_and_is_deterministic():
+    eng = _tiny_engine()
+    ids = np.zeros((2, 3), np.int32)
+    kw = dict(max_new_tokens=5, temperature=0.9, top_p=0.8,
+              repetition_penalty=1.3, seed=11)
+    out1 = np.asarray(eng.generate(ids, **kw))
+    out2 = np.asarray(eng.generate(ids, **kw))
+    np.testing.assert_array_equal(out1, out2)
+    assert out1.shape == (2, 8)
+
+
+def test_continuous_batcher_matches_generate():
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 512, size=(s,)).astype(np.int32)
+               for s in (4, 6, 3)]
+    singles = [np.asarray(eng.generate(p[None], max_new_tokens=6))[0]
+               for p in prompts]
+    # 2 slots for 3 requests forces a retire-then-admit cycle
+    batcher = ContinuousBatcher(eng, n_slots=2)
+    outs = batcher.run(prompts, max_new_tokens=6)
+    for got, want in zip(outs, singles):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_continuous_batcher_eos_retires_slot():
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    p = np.random.default_rng(4).integers(0, 512, size=(5,)).astype(np.int32)
+    free = np.asarray(eng.generate(p[None], max_new_tokens=8))[0]
+    gen = free[5:]
+    eos = int(gen[1])  # a token the greedy run definitely emits
+    stop = int(np.where(gen == eos)[0][0])  # first emission of it
+    batcher = ContinuousBatcher(eng, n_slots=1, eos_token_id=eos)
+    (out,) = batcher.run([p], max_new_tokens=8)
+    # stops right after the first EOS emission
+    assert len(out) == 5 + stop + 1 and out[-1] == eos
+
+
 def test_tp_serving_matches_single_chip():
     e1 = _tiny_engine(mp_size=1)
     ids = np.random.default_rng(3).integers(0, 512, size=(2, 8)).astype(np.int32)

@@ -12,7 +12,7 @@ from deepspeed_tpu.comm import mesh as mesh_mod
 from deepspeed_tpu.comm.mesh import build_mesh
 from deepspeed_tpu.parallel.pipeline import gpipe_loss
 from deepspeed_tpu.parallel.schedule import (
-    GPipeSchedule, InferenceSchedule, TrainSchedule,
+    GPipeSchedule, InferenceSchedule, InterleavedTrainSchedule, TrainSchedule,
 )
 
 
@@ -63,6 +63,53 @@ def test_train_schedule_warmup_depth():
             if "BackwardPass" in c:
                 assert seen_fwd >= 4  # 3 warmup + the 1F of this tick
                 return
+
+
+def test_interleaved_schedule_counts():
+    M, S, V = 8, 4, 2
+    for sid in range(S):
+        sched = InterleavedTrainSchedule(M, S, sid, virtual_stages=V)
+        steps = _flat(sched)
+        fwd = sum("ForwardPass" in c for step in steps for c in step)
+        bwd = sum("BackwardPass" in c for step in steps for c in step)
+        # each stage runs every (microbatch, chunk) pair once each direction
+        assert fwd == M * V and bwd == M * V
+        assert any("OptimizerStep" in c for step in steps for c in step)
+
+
+def test_interleaved_schedule_chunk_order():
+    # on any stage, a microbatch's chunk v must be forwarded before v+1,
+    # and backward order must reverse chunk order
+    M, S, V = 8, 4, 3
+    for sid in range(S):
+        sched = InterleavedTrainSchedule(M, S, sid, virtual_stages=V)
+        fwd_seen, bwd_seen = {}, {}
+        for step in sched:
+            for ins in step:
+                if ins.name == "ForwardPass":
+                    mb, ch = sched.unpack(ins.micro_batch_id)
+                    assert fwd_seen.get(mb, -1) == ch - 1
+                    fwd_seen[mb] = ch
+                elif ins.name == "BackwardPass":
+                    mb, ch = sched.unpack(ins.micro_batch_id)
+                    assert bwd_seen.get(mb, V) == ch + 1
+                    bwd_seen[mb] = ch
+        assert all(v == V - 1 for v in fwd_seen.values())
+        assert all(v == 0 for v in bwd_seen.values())
+
+
+def test_interleaved_bubble_shrinks():
+    M, S = 8, 4
+    plain = InterleavedTrainSchedule(M, S, 0, virtual_stages=1)
+    deep = InterleavedTrainSchedule(M, S, 0, virtual_stages=4)
+    assert deep.bubble_fraction == pytest.approx(plain.bubble_fraction / 4)
+
+
+def test_interleaved_schedule_validation():
+    with pytest.raises(ValueError):
+        InterleavedTrainSchedule(6, 4, 0, virtual_stages=2)  # M % S != 0
+    with pytest.raises(ValueError):
+        InterleavedTrainSchedule(8, 4, 0, virtual_stages=0)
 
 
 def test_inference_schedule():

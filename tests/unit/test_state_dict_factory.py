@@ -61,3 +61,78 @@ def test_megatron_loader_reshard(tmp_path):
 def test_split_indivisible_raises():
     with pytest.raises(ValueError):
         split_tp_shards(np.zeros((10, 3)), 4, ("vocab", "embed"))
+
+
+# ---------------- universal (tp × pp) resharding ----------------
+
+UAXES = {
+    "wte": ("vocab", "embed"),
+    "blocks": {"qkv_kernel": ("layers", "embed", "qkv"),
+               "proj_kernel": ("layers", "heads", "embed"),
+               "ln_scale": ("layers", "embed")},
+    "ln_f": {"scale": ("embed",)},
+}
+
+
+def _uparams(rng, n_layers=8):
+    return {
+        "wte": rng.normal(size=(64, 16)).astype(np.float32),
+        "blocks": {
+            "qkv_kernel": rng.normal(size=(n_layers, 16, 48)).astype(np.float32),
+            "proj_kernel": rng.normal(size=(n_layers, 16, 16)).astype(np.float32),
+            "ln_scale": np.ones((n_layers, 16), np.float32)},
+        "ln_f": {"scale": np.ones(16, np.float32)},
+    }
+
+
+def test_pp_axis_resolution():
+    from deepspeed_tpu.runtime.state_dict_factory import pp_axis_for
+    assert pp_axis_for(("layers", "embed", "qkv")) == 0
+    assert pp_axis_for(("embed", "qkv")) is None
+
+
+def test_universal_any_to_any(tmp_path):
+    """Save at (pp=2, tp=2), load back at every other grid — universal
+    checkpoint semantics (beyond reference v0.6.6)."""
+    from deepspeed_tpu.runtime.state_dict_factory import (
+        UniversalSDLoader, save_universal_shards,
+    )
+    rng = np.random.default_rng(3)
+    params = _uparams(rng)
+    grid = save_universal_shards(params, UAXES, tp_size=2, pp_size=2,
+                                 out_dir=str(tmp_path))
+    assert len(grid) == 2 and len(grid[0]) == 2
+    loader = UniversalSDLoader(grid, axes_tree=UAXES)
+
+    # 1×1 recovers the consolidated tree
+    full = loader.load(tp_size=1, tp_rank=0, pp_size=1, pp_rank=0)
+    np.testing.assert_array_equal(full["blocks"]["qkv_kernel"],
+                                  params["blocks"]["qkv_kernel"])
+    np.testing.assert_array_equal(full["wte"], params["wte"])
+
+    # pp regrouping 2 → 4: stage 3 holds layers 6..7
+    s3 = loader.load(tp_size=1, tp_rank=0, pp_size=4, pp_rank=3)
+    np.testing.assert_array_equal(s3["blocks"]["proj_kernel"],
+                                  params["blocks"]["proj_kernel"][6:8])
+    np.testing.assert_array_equal(s3["wte"], params["wte"])  # shared: replicated
+
+    # combined tp growth + pp shrink: (pp=1, tp=4) rank 2
+    r2 = loader.load(tp_size=4, tp_rank=2, pp_size=1, pp_rank=0)
+    np.testing.assert_array_equal(r2["wte"], params["wte"][32:48])
+    np.testing.assert_array_equal(r2["blocks"]["qkv_kernel"],
+                                  params["blocks"]["qkv_kernel"][:, :, 24:36])
+
+
+def test_universal_validates(tmp_path):
+    from deepspeed_tpu.runtime.state_dict_factory import (
+        UniversalSDLoader, save_universal_shards,
+    )
+    rng = np.random.default_rng(4)
+    params = _uparams(rng, n_layers=6)
+    grid = save_universal_shards(params, UAXES, tp_size=1, pp_size=2,
+                                 out_dir=str(tmp_path))
+    loader = UniversalSDLoader(grid, axes_tree=UAXES)
+    with pytest.raises(ValueError):   # 6 layers don't split 4 ways
+        loader.load(tp_size=1, tp_rank=0, pp_size=4, pp_rank=0)
+    with pytest.raises(ValueError):   # ragged grid
+        UniversalSDLoader([["a", "b"], ["c"]], axes_tree=UAXES)
