@@ -110,6 +110,26 @@ def _flash_spmd(q, k, v, *, causal, scale, interpret=False, flash_opts=None):
         return None
 
 
+def sp_flash_spec(mesh, batch_size: int, heads: int):
+    """PartitionSpec for running the flash ring engine under a FULL-manual
+    shard_map when ``sp`` coexists with other active mesh axes: batch over
+    the active data axes, heads over ``tp``.  None = not runnable (pp
+    nesting, or an axis that doesn't divide its dim) — caller falls back
+    to the partial-manual jnp ring.  Policy comes from the shared
+    ``kernel_mesh_plan`` (sp-aware mode)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas.spmd import kernel_mesh_plan
+
+    verdict, batch_axes = kernel_mesh_plan(batch_size, heads=heads,
+                                           allow_tp=True, sp=True, mesh=mesh)
+    if verdict != "shard":
+        return None
+    tp = mesh.shape.get("tp", 1)
+    return P(batch_axes if batch_axes else None, "sp",
+             "tp" if tp > 1 else None, None)
+
+
 def _sp_attention(q, k, v, *, causal, scale, kind):
     from functools import partial
 
@@ -127,25 +147,29 @@ def _sp_attention(q, k, v, *, causal, scale, kind):
                                            ring_attention_flash,
                                            ulysses_attention)
 
-    others = {a: s for a, s in mesh.shape.items() if a != "sp" and s > 1}
-    if kind == "ring" and on_tpu() and not others and q.shape[3] in (64, 128, 256):
-        # flash block engine (pallas): needs full-manual shard_map, which
-        # is only safe when sp is the sole active axis (a pallas_call under
-        # auto-sharded batch axes is opaque to the partitioner)
-        try:
-            mapped = shard_map(
-                partial(ring_attention_flash, axis_name="sp", causal=causal,
-                        scale=scale),
-                mesh=mesh,
-                in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
-                out_specs=P(None, "sp"),
-                check_vma=False,
-            )
-            return mapped(q, k, v)
-        except Exception as e:  # unsupported shape/backend: jnp ring below
-            from .pallas.spmd import _warn_once
+    if kind == "ring" and on_tpu() and q.shape[3] in (64, 128, 256):
+        # flash block engine (pallas): needs full-manual shard_map, so
+        # every ACTIVE axis must appear in the specs — batch dims over the
+        # data axes, heads over tp (a pallas_call under auto-sharded axes
+        # is opaque to the partitioner).  pp refuses: pipeline code is
+        # already inside its own manual shard_map.
+        spec = sp_flash_spec(mesh, q.shape[0], q.shape[2])
+        if spec is not None:
+            try:
+                mapped = shard_map(
+                    partial(ring_attention_flash, axis_name="sp",
+                            causal=causal, scale=scale),
+                    mesh=mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    check_vma=False,
+                )
+                return mapped(q, k, v)
+            except Exception as e:  # unsupported shape/backend: jnp ring below
+                from .pallas.spmd import _warn_once
 
-            _warn_once("ring_attention_flash", f"{type(e).__name__}: {e}"[:200])
+                _warn_once("ring_attention_flash",
+                           f"{type(e).__name__}: {e}"[:200])
     fn = ring_attention if kind == "ring" else ulysses_attention
     mapped = shard_map(
         partial(fn, axis_name="sp", causal=causal, scale=scale),

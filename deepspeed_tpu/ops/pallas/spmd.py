@@ -24,18 +24,21 @@ from ...utils.logging import logger
 
 
 def kernel_mesh_plan(batch_size: int, *, heads: Optional[int] = None,
-                     allow_tp: bool = False
+                     allow_tp: bool = False, sp: bool = False, mesh=None
                      ) -> Tuple[Optional[str], Optional[tuple]]:
     """Decide how a batch-parallel Pallas kernel may run under the mesh.
 
-    ``pp``/``sp`` meshes refuse: pipeline code is already inside a manual
-    shard_map over ``pp`` (nesting full-manual would throw), and ``sp``
-    shards the sequence dim which batch-parallel kernels cannot split.
-    ``tp`` is allowed only when the kernel shards heads (``allow_tp``).
+    ``pp`` meshes refuse: pipeline code is already inside a manual
+    shard_map over ``pp`` (nesting full-manual would throw).  ``sp``
+    refuses too unless the kernel IS sequence-parallel (``sp=True`` — the
+    ring engine, which handles the sequence dim itself); batch-parallel
+    kernels cannot split it.  ``tp`` is allowed only when the kernel
+    shards heads (``allow_tp``).
     """
     import jax
 
-    mesh = get_mesh(required=False)
+    if mesh is None:
+        mesh = get_mesh(required=False)
     if mesh is None:
         if jax.device_count() > 1:
             return None, None   # unknown shardings: kernel would be opaque
@@ -43,7 +46,9 @@ def kernel_mesh_plan(batch_size: int, *, heads: Optional[int] = None,
     n_dev = int(np.prod(list(mesh.shape.values())))
     if n_dev == 1:
         return "direct", None
-    if mesh.shape.get("pp", 1) > 1 or mesh.shape.get("sp", 1) > 1:
+    if mesh.shape.get("pp", 1) > 1:
+        return None, None
+    if not sp and mesh.shape.get("sp", 1) > 1:
         return None, None
     tp = mesh.shape.get("tp", 1)
     if tp > 1 and not (allow_tp and heads is not None and heads % tp == 0):

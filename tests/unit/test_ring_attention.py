@@ -148,3 +148,70 @@ def test_ring_flash_non_causal():
                          dropout_rate=0.0, dropout_rng=None, scale=None)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_sp_flash_spec_planning():
+    """Dispatch planning for the flash ring engine when sp shares the mesh
+    with other active axes."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.ops.attention import sp_flash_spec
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    assert sp_flash_spec(mesh, batch_size=4, heads=4) == \
+        P(("dp",), "sp", "tp", None)
+    assert sp_flash_spec(mesh, batch_size=4, heads=3) is None     # H % tp
+    assert sp_flash_spec(mesh, batch_size=3, heads=4) is None     # B % dp
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"pp": 2, "sp": 4})
+    assert sp_flash_spec(mesh, batch_size=4, heads=4) is None     # pp nesting
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"sp": 8})
+    assert sp_flash_spec(mesh, batch_size=1, heads=2) == \
+        P(None, "sp", None, None)
+
+
+def test_ring_flash_with_dp_and_tp_axes():
+    """Flash-engine ring under a FULL-manual shard_map with dp AND tp
+    active alongside sp (the composition the dispatch now builds) must
+    still equal full attention — values and gradients."""
+    from functools import partial
+
+    import numpy as np
+    from jax import shard_map
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.ops.attention import _jnp_attention, sp_flash_spec
+    from deepspeed_tpu.parallel.ring_attention import ring_attention_flash
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    rng = np.random.default_rng(1)
+    B, S, H, D = 2, 256, 4, 64
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+
+    spec = sp_flash_spec(mesh, B, H)
+    assert spec is not None
+    mapped = shard_map(
+        partial(ring_attention_flash, axis_name="sp", causal=True,
+                interpret=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+
+    out = mapped(q, k, v)
+    ref = _jnp_attention(q, k, v, causal=True, bias=None, mask=None,
+                         dropout_rate=0.0, dropout_rng=None, scale=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    g1 = jax.grad(lambda q, k, v: (mapped(q, k, v) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: (_jnp_attention(
+        q, k, v, causal=True, bias=None, mask=None, dropout_rate=0.0,
+        dropout_rng=None, scale=None) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=3e-4, atol=3e-4)
