@@ -102,8 +102,9 @@ class Autotuner:
             # the live-measurement pass)
             if hasattr(model, "cfg") and hasattr(model.cfg, "flash_block") \
                     and self._flash_possible(model):
+                # variants DIFFERENT from the kernel default (512x512)
                 self.kernel_options += [
-                    {"flash_block": (512, 512)},
+                    {"flash_block": (1024, 1024)},
                     {"flash_block": (256, 256)},
                     {"flash_heads_per_program": 2},
                 ]

@@ -56,7 +56,8 @@ class ContinuousBatcher:
 
     def __init__(self, engine: InferenceEngine, n_slots: int = 4, *,
                  top_k: int = 0, eos_token_id: Optional[int] = None,
-                 pad_token_id: Optional[int] = None, seed: int = 0):
+                 pad_token_id: Optional[int] = None, seed: int = 0,
+                 chunked_prefill: bool = True):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
@@ -66,6 +67,7 @@ class ContinuousBatcher:
         self.pad = int(pad_token_id if pad_token_id is not None
                        else (eos_token_id if eos_token_id is not None else 0))
         self.seed = seed
+        self.chunked_prefill = chunked_prefill
         cfg = engine.decode_cfg
         self._vocab = int(getattr(cfg, "padded_vocab_size", None)
                           or cfg.vocab_size)
@@ -118,10 +120,11 @@ class ContinuousBatcher:
         # into the program and recompile per slot — pathological on a
         # tunneled device where each compile pays seconds of RTT)
         def admit_fn(cache, token, pos, temp, top_p, rep, seen, done,
-                     cache1, logits, ids, uid, i, r_temp, r_top_p, r_rep):
+                     cache1, last_logits, prompt_seen, prompt_len, uid, i,
+                     r_temp, r_top_p, r_rep):
             key = jax.random.fold_in(jax.random.PRNGKey(base_seed), uid)
-            seen1 = engine._seen_mask_from(ids[None, :], self._vocab)
-            first = _sample(logits[:, -1, :].astype(jnp.float32), key,
+            seen1 = prompt_seen
+            first = _sample(last_logits.astype(jnp.float32), key,
                             r_temp, top_k_static, r_top_p, r_rep, seen1)
             seen1 = seen1.at[jnp.arange(1), first].set(True)
 
@@ -132,7 +135,7 @@ class ContinuousBatcher:
 
             cache = jax.tree_util.tree_map(put, cache, cache1)
             token = put(token, first[:, None])
-            pos = put(pos, jnp.int32(ids.shape[0]))
+            pos = put(pos, jnp.int32(prompt_len))
             temp = put(temp, r_temp)
             top_p = put(top_p, r_top_p)
             rep = put(rep, r_rep)
@@ -147,6 +150,8 @@ class ContinuousBatcher:
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
                top_p: float = 1.0, repetition_penalty: float = 1.0) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
         if len(prompt) + max_new_tokens > self.engine._gen_limit:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
@@ -162,6 +167,34 @@ class ContinuousBatcher:
         return len(self._queue) + sum(s is not None for s in self._slots)
 
     # ------------------------------------------------------------------
+    def _prefill(self, ids):
+        """B=1 prefill of the whole prompt into a fresh cache.
+
+        ``chunked_prefill`` feeds the prompt as DESCENDING power-of-two
+        chunks (the binary decomposition of its length), so across every
+        prompt length the compile cache holds at most log2(max_len)
+        prefill executables instead of one per distinct length — each
+        chunk appends at its exact positions, so the cache stays exact
+        (no pad pollution).  Returns (last-chunk logits, cache)."""
+        eng = self.engine
+        cache = eng.init_cache(1)
+        S = ids.shape[1]
+        if not self.chunked_prefill:
+            return eng._compiled_prefill(eng.params, cache, ids,
+                                         jnp.arange(S)[None, :])
+        pos = 0
+        logits = None
+        chunk = 1 << (S.bit_length() - 1)
+        while chunk:
+            if S & chunk:
+                seg = ids[:, pos:pos + chunk]
+                positions = (pos + jnp.arange(chunk))[None, :]
+                logits, cache = eng._compiled_prefill(eng.params, cache,
+                                                      seg, positions)
+                pos += chunk
+            chunk >>= 1
+        return logits, cache
+
     def _admit(self):
         eng = self.engine
         for i in range(self.n_slots):
@@ -169,16 +202,18 @@ class ContinuousBatcher:
                 continue
             req = self._queue.popleft()
             ids = jnp.asarray(req.prompt)[None, :]
-            S = ids.shape[1]
-            cache1 = eng.init_cache(1)
-            positions = jnp.arange(S)[None, :]
-            logits, cache1 = eng._compiled_prefill(eng.params, cache1,
-                                                   ids, positions)
+            logits, cache1 = self._prefill(ids)
+            # fixed shapes only reach the jitted admission: the last-token
+            # logits row and a HOST-built (1, V) prompt mask — so it
+            # compiles exactly once across all prompt lengths
+            prompt_seen = np.zeros((1, self._vocab), bool)
+            prompt_seen[0, req.prompt] = True
             (self._cache, self._token, self._pos, self._temp, self._top_p,
              self._rep, self._seen, self._done, first) = self._admit_fn(
                 self._cache, self._token, self._pos, self._temp,
                 self._top_p, self._rep, self._seen, self._done,
-                cache1, logits, jnp.asarray(req.prompt), req.uid, i,
+                cache1, logits[:, -1, :], jnp.asarray(prompt_seen),
+                len(req.prompt), req.uid, i,
                 req.temperature, req.top_p, req.repetition_penalty)
             first_host = int(jax.device_get(first)[0])
             done0 = first_host == self.eos or req.max_new_tokens <= 1

@@ -1,0 +1,85 @@
+"""Small cluster CLI tools.
+
+Analogs of the reference's auxiliary binaries (``bin/ds_ssh``,
+``bin/ds_elastic``): ``dstpu_ssh`` fans a shell command out to every
+hostfile host over ssh; ``dstpu_elastic`` prints the elastic-batch
+analysis for a config (valid GPU counts per candidate batch size —
+``elasticity/elasticity.py`` math).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+from .runner import filter_hosts, parse_hostfile
+
+
+def ssh_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu_ssh", description="run a command on every hostfile host")
+    p.add_argument("--hostfile", type=str, required=True)
+    p.add_argument("--include", type=str, default="")
+    p.add_argument("--exclude", type=str, default="")
+    p.add_argument("--ssh_port", type=int, default=22)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    import shlex
+
+    cmd = shlex.join(args.command)   # preserve argv quoting on the remote
+    hosts = filter_hosts(parse_hostfile(args.hostfile), args.include,
+                         args.exclude)
+    rc = 0
+    for host in hosts:
+        out = subprocess.run(["ssh", "-p", str(args.ssh_port), host, cmd],
+                             capture_output=True, text=True)
+        sys.stdout.write(f"=== {host} (rc={out.returncode}) ===\n")
+        sys.stdout.write(out.stdout)
+        if out.stderr:
+            sys.stderr.write(out.stderr)
+        rc = rc or out.returncode
+    return rc
+
+
+def elastic_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu_elastic",
+        description="show elastic batch-size analysis for a config JSON")
+    p.add_argument("config", type=str)
+    p.add_argument("--world_size", type=int, default=0,
+                   help="also resolve the final batch/micro/gas for this "
+                        "accelerator count")
+    args = p.parse_args(argv)
+    from ..elasticity.elasticity import (ElasticityError,
+                                         compute_elastic_config,
+                                         elasticity_enabled)
+
+    try:
+        with open(args.config) as fh:
+            cfg = json.load(fh)
+        if not elasticity_enabled(cfg):
+            print("elasticity is not enabled in this config")
+            return 1
+        if args.world_size:
+            final_batch, valid_gpus, micro = compute_elastic_config(
+                cfg, world_size=args.world_size)
+            gas = final_batch // (args.world_size * micro)
+            print(json.dumps({"final_batch_size": final_batch,
+                              "valid_gpus": valid_gpus,
+                              "micro_batch_per_gpu": micro,
+                              "gradient_accumulation_steps": gas}, indent=2))
+        else:
+            final_batch, valid_gpus = compute_elastic_config(cfg)
+            print(json.dumps({"final_batch_size": final_batch,
+                              "valid_gpus": valid_gpus}, indent=2))
+    except (ElasticityError, OSError, json.JSONDecodeError, KeyError) as e:
+        print(f"dstpu_elastic: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(ssh_main())

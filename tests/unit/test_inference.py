@@ -152,6 +152,25 @@ def test_continuous_batcher_matches_generate():
         np.testing.assert_array_equal(got, want)
 
 
+def test_continuous_batcher_chunked_prefill_exact():
+    """Binary-decomposition chunked prefill (bounded compile shapes) must
+    be indistinguishable from whole-prompt prefill — odd lengths included."""
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 512, size=(s,)).astype(np.int32)
+               for s in (13, 1, 8, 21)]   # 13=8+4+1, 21=16+4+1
+    chunked = ContinuousBatcher(eng, n_slots=2, chunked_prefill=True)
+    whole = ContinuousBatcher(eng, n_slots=2, chunked_prefill=False)
+    out_c = chunked.run(prompts, max_new_tokens=5)
+    out_w = whole.run(prompts, max_new_tokens=5)
+    for a, b in zip(out_c, out_w):
+        np.testing.assert_array_equal(a, b)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        chunked.submit(np.zeros((0,), np.int32))
+
+
 def test_continuous_batcher_eos_retires_slot():
     from deepspeed_tpu.inference.serving import ContinuousBatcher
     eng = _tiny_engine()

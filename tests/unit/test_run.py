@@ -123,3 +123,59 @@ def test_launcher_rejects_sub_throttle_timeout(tmp_path):
     with _pytest.raises(ValueError):
         main(["--num_processes", "1", "--heartbeat_timeout", "0.5",
               str(script)])
+
+
+# ---------------- auxiliary CLI tools (ds_ssh / ds_elastic analogs) ----------
+
+def test_dstpu_elastic_cli(tmp_path, capsys):
+    import json
+
+    from deepspeed_tpu.launcher.tools import elastic_main
+
+    cfg = {"elasticity": {"enabled": True,
+                          "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4, 8],
+                          "min_gpus": 1, "max_gpus": 16}}
+    path = tmp_path / "ds.json"
+    path.write_text(json.dumps(cfg))
+    assert elastic_main([str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["final_batch_size"] > 0 and out["valid_gpus"]
+
+    assert elastic_main([str(path), "--world_size",
+                         str(out["valid_gpus"][0])]) == 0
+    out2 = json.loads(capsys.readouterr().out)
+    ws, micro, gas = out2["valid_gpus"], out2["micro_batch_per_gpu"], \
+        out2["gradient_accumulation_steps"]
+    assert out2["final_batch_size"] == \
+        micro * gas * out["valid_gpus"][0]
+
+    path.write_text(json.dumps({"elasticity": {"enabled": False}}))
+    assert elastic_main([str(path)]) == 1
+
+
+def test_dstpu_ssh_parses_and_reports(tmp_path, monkeypatch):
+    """ssh fan-out uses the hostfile parser + per-host rc aggregation
+    (commands stubbed — no real ssh in tests)."""
+    import subprocess as sp
+
+    from deepspeed_tpu.launcher import tools
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("h0 slots=1\nh1 slots=1\n")
+    calls = []
+
+    def fake_run(cmd, capture_output, text):
+        calls.append(cmd)
+        class R:
+            returncode = 0 if cmd[-2] != "h1" else 3
+            stdout = f"out-{cmd[-2]}\n"
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    monkeypatch.setattr(tools, "subprocess", sp)
+    rc = tools.ssh_main(["--hostfile", str(hf), "uptime"])
+    assert rc == 3
+    assert [c[-2] for c in calls] == ["h0", "h1"]
+    assert all(c[-1] == "uptime" for c in calls)
