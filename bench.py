@@ -86,11 +86,13 @@ def main():
     from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
 
     if on_tpu:
-        preset, seq, micro = MODEL, SEQ, 12
+        # micro=24 + remat measured fastest on the bench chip (95.7k tok/s
+        # vs 92.6k at micro=12 no-remat; micro>=16 without remat OOMs HBM)
+        preset, seq, micro, remat = MODEL, SEQ, 24, True
     else:  # CI / smoke fallback
-        preset, seq, micro = "gpt2-tiny", 128, 4
+        preset, seq, micro, remat = "gpt2-tiny", 128, 4, False
 
-    cfg = gpt2_config(preset, n_positions=seq, scan_layers=True, remat=False,
+    cfg = gpt2_config(preset, n_positions=seq, scan_layers=True, remat=remat,
                       attn_impl="auto")
     model = GPT2LMHeadModel(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(

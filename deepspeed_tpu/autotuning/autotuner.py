@@ -102,12 +102,15 @@ class Autotuner:
             # the live-measurement pass)
             if hasattr(model, "cfg") and hasattr(model.cfg, "flash_block") \
                     and self._flash_possible(model):
-                # variants DIFFERENT from the kernel default (512x512)
+                # tile variants to probe; drop any identical to the
+                # model's CURRENT effective config (the baseline {} trial
+                # already covers it — kernel default is 512x512)
+                current = model.cfg.flash_block or (512, 512)
                 self.kernel_options += [
-                    {"flash_block": (1024, 1024)},
-                    {"flash_block": (256, 256)},
-                    {"flash_heads_per_program": 2},
-                ]
+                    {"flash_block": blk}
+                    for blk in ((1024, 1024), (512, 512), (256, 256))
+                    if blk != tuple(current)
+                ] + [{"flash_heads_per_program": 2}]
         self.hbm_budget = _chip_spec()["hbm"] * hbm_budget_fraction
         self.seq_len = seq_len
         self.results: list[TrialResult] = []

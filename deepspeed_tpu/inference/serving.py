@@ -152,6 +152,10 @@ class ContinuousBatcher:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if prompt.min() < 0 or prompt.max() >= self._vocab:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self._vocab}); got "
+                f"range [{prompt.min()}, {prompt.max()}]")
         if len(prompt) + max_new_tokens > self.engine._gen_limit:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "

@@ -30,17 +30,26 @@ def ssh_main(argv=None) -> int:
     import shlex
 
     cmd = shlex.join(args.command)   # preserve argv quoting on the remote
-    hosts = filter_hosts(parse_hostfile(args.hostfile), args.include,
-                         args.exclude)
+    try:
+        hosts = filter_hosts(parse_hostfile(args.hostfile), args.include,
+                             args.exclude)
+    except (OSError, ValueError) as e:
+        print(f"dstpu_ssh: {e}", file=sys.stderr)
+        return 1
+    # parallel fan-out (the pdsh model): launch every ssh at once, then
+    # collect in host order
+    procs = {host: subprocess.Popen(
+        ["ssh", "-p", str(args.ssh_port), host, cmd],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for host in hosts}
     rc = 0
-    for host in hosts:
-        out = subprocess.run(["ssh", "-p", str(args.ssh_port), host, cmd],
-                             capture_output=True, text=True)
-        sys.stdout.write(f"=== {host} (rc={out.returncode}) ===\n")
-        sys.stdout.write(out.stdout)
-        if out.stderr:
-            sys.stderr.write(out.stderr)
-        rc = rc or out.returncode
+    for host, pr in procs.items():
+        stdout, stderr = pr.communicate()
+        sys.stdout.write(f"=== {host} (rc={pr.returncode}) ===\n")
+        sys.stdout.write(stdout)
+        if stderr:
+            sys.stderr.write(stderr)
+        rc = rc or pr.returncode
     return rc
 
 

@@ -165,17 +165,21 @@ def test_dstpu_ssh_parses_and_reports(tmp_path, monkeypatch):
     hf.write_text("h0 slots=1\nh1 slots=1\n")
     calls = []
 
-    def fake_run(cmd, capture_output, text):
-        calls.append(cmd)
-        class R:
-            returncode = 0 if cmd[-2] != "h1" else 3
-            stdout = f"out-{cmd[-2]}\n"
-            stderr = ""
-        return R()
+    class FakeProc:
+        def __init__(self, cmd, **kw):
+            calls.append(cmd)
+            self.returncode = 0 if cmd[-2] != "h1" else 3
+            self._host = cmd[-2]
 
-    monkeypatch.setattr(sp, "run", fake_run)
+        def communicate(self):
+            return f"out-{self._host}\n", ""
+
+    monkeypatch.setattr(sp, "Popen", FakeProc)
     monkeypatch.setattr(tools, "subprocess", sp)
-    rc = tools.ssh_main(["--hostfile", str(hf), "uptime"])
+    rc = tools.ssh_main(["--hostfile", str(hf), "grep", "foo bar"])
     assert rc == 3
     assert [c[-2] for c in calls] == ["h0", "h1"]
-    assert all(c[-1] == "uptime" for c in calls)
+    # argv quoting preserved on the remote command line
+    assert all(c[-1] == "grep 'foo bar'" for c in calls)
+    # bad hostfile: clean error, no traceback
+    assert tools.ssh_main(["--hostfile", "/no/such/file", "uptime"]) == 1
