@@ -92,7 +92,10 @@ def main():
     else:  # CI / smoke fallback
         preset, seq, micro, remat = "gpt2-tiny", 128, 4, False
 
+    # policy sweep at micro=24: dots_with_no_batch_dims_saveable 95.6k
+    # vs nothing_saveable 94.8k (fused_mlp 81k — stays opt-in)
     cfg = gpt2_config(preset, n_positions=seq, scan_layers=True, remat=remat,
+                      remat_policy="dots_with_no_batch_dims_saveable",
                       attn_impl="auto")
     model = GPT2LMHeadModel(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(

@@ -163,6 +163,45 @@ def host_all_reduce_sum(tree):
     return jax.tree.map(lambda x: multihost_utils.process_allgather(x).sum(axis=0), tree)
 
 
+def assert_same_across_processes(obj, name: str = "value") -> None:
+    """Cross-process invariant check — the ``safe_mode`` /
+    checkpoint-tag-validation analog (reference
+    ``assert_ints_same_as_other_ranks`` ``stage3.py:1590-1592``,
+    ``_checkpoint_tag_validation`` ``engine.py:2733``): every process must
+    hold an identical ``obj`` (string, int, or small array-able value).
+    No-op single-process; raises ``RuntimeError`` naming ``name`` on
+    divergence."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    import hashlib
+    import json
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    def _canonical_bytes(o) -> bytes:
+        # repr() is NOT stable across processes (hash-randomized set/dict
+        # ordering) and truncates large arrays; serialize canonically
+        if isinstance(o, bytes):
+            return o
+        if isinstance(o, np.ndarray) or hasattr(o, "dtype"):
+            arr = np.asarray(o)
+            return arr.dtype.str.encode() + str(arr.shape).encode() \
+                + np.ascontiguousarray(arr).tobytes()
+        return json.dumps(o, sort_keys=True, default=repr).encode()
+
+    digest = np.frombuffer(
+        hashlib.sha256(_canonical_bytes(obj)).digest()[:8], np.int64)
+    gathered = multihost_utils.process_allgather(digest)
+    if not (gathered == gathered[0]).all():
+        raise RuntimeError(
+            f"cross-process consistency check failed for {name!r}: "
+            f"process {jax.process_index()} holds {obj!r} but digests "
+            f"disagree across the job")
+
+
 # ---------------------------------------------------------------------------
 # Trace plane — legal inside jit / shard_map over named axes
 # ---------------------------------------------------------------------------
@@ -244,6 +283,34 @@ def broadcast(x, axis, src: int = 0):
 
     idx = lax.axis_index(axis)
     return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axis)
+
+
+def same_across_ranks(x, axis):
+    """In-trace invariant check (the cheap psum-based consistency assert
+    SURVEY §5 keeps from the reference's ``safe_mode``): True iff every
+    rank on ``axis`` holds the same ``x``.  Composable — returns a traced
+    bool scalar; feed it to ``jax.debug.check``/metrics rather than a
+    Python assert (no data-dependent control flow under jit)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    xf = jnp.asarray(x)
+    lo = lax.pmin(xf, axis)
+    hi = lax.pmax(xf, axis)
+    ok = jnp.logical_and(jnp.all(xf == lo), jnp.all(xf == hi))
+    if jnp.issubdtype(xf.dtype, jnp.inexact):
+        # XLA's all-reduce min/max IGNORES NaN (all-NaN pmin is +inf), so
+        # NaN agreement needs its own reduction: the isnan pattern must
+        # match on every rank, and non-NaN elements must pass the
+        # value check.  Identical NaNs everywhere count as consistent
+        # (NaN != NaN would otherwise flag the very state this checker
+        # helps debug); NaN on only some ranks is divergence.
+        nanf = jnp.isnan(xf).astype(jnp.float32)
+        nan_same = jnp.all(lax.pmin(nanf, axis) == lax.pmax(nanf, axis))
+        vals_ok = jnp.all(jnp.logical_or(
+            jnp.isnan(xf), jnp.logical_and(xf == lo, xf == hi)))
+        ok = jnp.logical_and(nan_same, vals_ok)
+    return ok
 
 
 def axis_rank(axis):

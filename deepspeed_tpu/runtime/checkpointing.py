@@ -197,7 +197,24 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     Returns ``(ckpt_dir, client_state)`` like the reference ``load_checkpoint``.
     """
-    tag = _resolve_tag(load_dir, tag)
+    # every process must resolve the SAME tag (reference
+    # `_checkpoint_tag_validation` engine.py:2733 — a half-written
+    # `latest` on shared storage could desynchronize hosts).  The resolve
+    # is fenced so a process that FAILS to resolve still reaches the
+    # collective (otherwise the healthy hosts would hang in allgather —
+    # the exact propagation race this check exists for).
+    from .. import comm
+
+    resolve_err: Optional[Exception] = None
+    try:
+        tag = _resolve_tag(load_dir, tag)
+    except (FileNotFoundError, OSError) as e:
+        tag, resolve_err = None, e
+    comm.assert_same_across_processes(
+        ("ok", tag) if resolve_err is None else ("missing", None),
+        name="checkpoint tag")
+    if resolve_err is not None:
+        raise resolve_err
     ckpt_dir = os.path.abspath(os.path.join(load_dir, tag))
     state_path = os.path.join(ckpt_dir, MODULE_DIR)
     if not os.path.isdir(state_path):

@@ -200,3 +200,72 @@ def test_dcn_rejects_nonpositive():
 
     with pytest.raises(ValueError):
         build_mesh({"dp": 8}, dcn={"dp": 0})
+
+
+# ---------------- cross-rank consistency checks (safe_mode analog) ----------
+
+def test_same_across_ranks_invariant():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"dp": 8})
+
+    def check(x):
+        return comm.same_across_ranks(x, "dp")
+
+    same = shard_map(check, mesh=mesh, in_specs=P(), out_specs=P(),
+                     check_vma=False)(jnp.float32(3.0))
+    assert bool(np.asarray(same).all())
+
+    def check_diverged(x):
+        from jax import lax
+        val = x + lax.axis_index("dp")          # rank-dependent
+        return comm.same_across_ranks(val, "dp")
+
+    diverged = shard_map(check_diverged, mesh=mesh, in_specs=P(),
+                         out_specs=P(), check_vma=False)(jnp.float32(3.0))
+    assert not bool(np.asarray(diverged).all())
+    mesh_mod.set_mesh(None)
+
+
+def test_assert_same_across_processes_single_is_noop():
+    from deepspeed_tpu import comm
+
+    comm.assert_same_across_processes("global_step7", name="tag")
+    comm.assert_same_across_processes({"a": 1}, name="cfg")
+
+
+def test_same_across_ranks_nan_consistent():
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"dp": 8})
+    # identical NaN everywhere = consistent
+    same = shard_map(lambda x: comm.same_across_ranks(x, "dp"),
+                     mesh=mesh, in_specs=P(), out_specs=P(),
+                     check_vma=False)(jnp.float32(np.nan))
+    assert bool(np.asarray(same).all())
+
+    # NaN on only one rank = divergence
+    def one_nan(x):
+        from jax import lax
+        val = jnp.where(lax.axis_index("dp") == 0, jnp.nan, x)
+        return comm.same_across_ranks(val, "dp")
+
+    div = shard_map(one_nan, mesh=mesh, in_specs=P(), out_specs=P(),
+                    check_vma=False)(jnp.float32(1.0))
+    assert not bool(np.asarray(div).all())
+    mesh_mod.set_mesh(None)
