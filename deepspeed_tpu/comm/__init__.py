@@ -181,6 +181,16 @@ def assert_same_across_processes(obj, name: str = "value") -> None:
     import numpy as np
     from jax.experimental import multihost_utils
 
+    def _json_default(o):
+        if isinstance(o, (set, frozenset)):
+            return sorted(o, key=repr)   # deterministic for str/int members
+        # repr of arbitrary objects is NOT stable across processes
+        # (memory addresses, hash-randomized ordering): refuse loudly
+        # rather than report a spurious divergence
+        raise TypeError(
+            f"assert_same_across_processes: unsupported type "
+            f"{type(o).__name__} — pass str/int/list/dict/array values")
+
     def _canonical_bytes(o) -> bytes:
         # repr() is NOT stable across processes (hash-randomized set/dict
         # ordering) and truncates large arrays; serialize canonically
@@ -190,7 +200,7 @@ def assert_same_across_processes(obj, name: str = "value") -> None:
             arr = np.asarray(o)
             return arr.dtype.str.encode() + str(arr.shape).encode() \
                 + np.ascontiguousarray(arr).tobytes()
-        return json.dumps(o, sort_keys=True, default=repr).encode()
+        return json.dumps(o, sort_keys=True, default=_json_default).encode()
 
     digest = np.frombuffer(
         hashlib.sha256(_canonical_bytes(obj)).digest()[:8], np.int64)

@@ -147,18 +147,35 @@ def _sp_attention(q, k, v, *, causal, scale, kind):
                                            ring_attention_flash,
                                            ulysses_attention)
 
-    if kind == "ring" and on_tpu() and q.shape[3] in (64, 128, 256):
+    if on_tpu() and q.shape[3] in (64, 128, 256):
         # flash block engine (pallas): needs full-manual shard_map, so
         # every ACTIVE axis must appear in the specs — batch dims over the
         # data axes, heads over tp (a pallas_call under auto-sharded axes
         # is opaque to the partitioner).  pp refuses: pipeline code is
-        # already inside its own manual shard_map.
+        # already inside its own manual shard_map.  For "ulysses" the
+        # heads additionally split by sp (all-to-all inside), so H must
+        # divide tp*sp.
         spec = sp_flash_spec(mesh, q.shape[0], q.shape[2])
+        sp_n = mesh.shape.get("sp", 1)
+        tp_n = mesh.shape.get("tp", 1)
+        if kind == "ulysses" and q.shape[2] % (sp_n * tp_n):
+            spec = None
         if spec is not None:
+            from .pallas.flash_attention import flash_attention
+
+            if kind == "ring":
+                fn = partial(ring_attention_flash, axis_name="sp",
+                             causal=causal, scale=scale)
+            else:
+                # Ulysses with the flash kernel as the full-sequence
+                # engine: inside the manual region each rank holds the
+                # whole sequence on H/(sp·tp) heads after the all-to-all
+                fn = partial(ulysses_attention, axis_name="sp",
+                             causal=causal, scale=scale,
+                             attend_fn=partial(flash_attention))
             try:
                 mapped = shard_map(
-                    partial(ring_attention_flash, axis_name="sp",
-                            causal=causal, scale=scale),
+                    fn,
                     mesh=mesh,
                     in_specs=(spec, spec, spec),
                     out_specs=spec,
@@ -168,7 +185,7 @@ def _sp_attention(q, k, v, *, causal, scale, kind):
             except Exception as e:  # unsupported shape/backend: jnp ring below
                 from .pallas.spmd import _warn_once
 
-                _warn_once("ring_attention_flash",
+                _warn_once(f"{kind}_attention_flash",
                            f"{type(e).__name__}: {e}"[:200])
     fn = ring_attention if kind == "ring" else ulysses_attention
     mapped = shard_map(

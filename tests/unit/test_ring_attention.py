@@ -215,3 +215,37 @@ def test_ring_flash_with_dp_and_tp_axes():
     for a, r in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=3e-4, atol=3e-4)
+
+
+def test_ulysses_flash_with_dp_and_tp_axes():
+    """Ulysses SP with the flash kernel as the full-sequence engine,
+    under the full-manual composed-mesh specs the dispatch builds."""
+    from functools import partial
+
+    import numpy as np
+    from jax import shard_map
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.ops.attention import _jnp_attention, sp_flash_spec
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.parallel.ring_attention import ulysses_attention
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    rng = np.random.default_rng(2)
+    B, S, H, D = 2, 256, 4, 64   # H divides sp*tp = 4
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+
+    spec = sp_flash_spec(mesh, B, H)
+    mapped = shard_map(
+        partial(ulysses_attention, axis_name="sp", causal=True,
+                attend_fn=partial(flash_attention, interpret=True)),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    out = mapped(q, k, v)
+    ref = _jnp_attention(q, k, v, causal=True, bias=None, mask=None,
+                         dropout_rate=0.0, dropout_rng=None, scale=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    mesh_mod.set_mesh(None)
