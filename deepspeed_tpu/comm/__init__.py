@@ -184,6 +184,8 @@ def assert_same_across_processes(obj, name: str = "value") -> None:
     def _json_default(o):
         if isinstance(o, (set, frozenset)):
             return sorted(o, key=repr)   # deterministic for str/int members
+        if isinstance(o, np.generic):    # numpy scalars nested in trees
+            return o.item()
         # repr of arbitrary objects is NOT stable across processes
         # (memory addresses, hash-randomized ordering): refuse loudly
         # rather than report a spurious divergence

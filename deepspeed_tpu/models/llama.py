@@ -126,9 +126,24 @@ class LlamaAttention(nn.Module):
                 cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
             idx.value = cur + S
             k_full, v_full = ck.value, cv.value
+            from ..ops.attention import on_tpu
+            from ..ops.pallas.decode_attention import (decode_attention,
+                                                       fits_vmem)
+
+            if S == 1 and attn_mask is None and on_tpu() and \
+                    fits_vmem(cfg.max_position_embeddings, KV, D,
+                              k_full.dtype.itemsize):
+                # single-token tick → fused GQA decode kernel (KV panels
+                # stay at KV heads — no repeat materialized)
+                y = decode_attention(q, k_full, v_full, cur + 1)
+                y = y.reshape(B, S, H * D)
+                return _dense(y, E, ("heads", "embed"), cfg=cfg,
+                              name="o_proj", module=self)
             q_pos = cur + jnp.arange(S)[:, None]
             k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
             mask = (k_pos <= q_pos)[None, None, :, :]
+            if attn_mask is not None:   # padded batches: AND the user mask
+                mask = jnp.logical_and(mask, attn_mask)
             causal = False
         else:
             k_full, v_full, mask, causal = k, v, attn_mask, True

@@ -172,7 +172,7 @@ def _sp_attention(q, k, v, *, causal, scale, kind):
                 # whole sequence on H/(sp·tp) heads after the all-to-all
                 fn = partial(ulysses_attention, axis_name="sp",
                              causal=causal, scale=scale,
-                             attend_fn=partial(flash_attention))
+                             attend_fn=flash_attention)
             try:
                 mapped = shard_map(
                     fn,

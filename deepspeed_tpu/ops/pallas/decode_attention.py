@@ -45,14 +45,19 @@ def fits_vmem(s: int, h: int, d: int, itemsize: int) -> bool:
     return 2 * s * h * d * itemsize <= _VMEM_BUDGET_BYTES
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads,
+                   n_kv_heads):
     L = len_ref[pl.program_id(0)]
-    for h in range(n_heads):
-        q = q_ref[0, 0, h].astype(jnp.float32)[None, :] * scale      # (1, D)
-        k = k_ref[0, :, h].astype(jnp.float32)                       # (S, D)
-        v = v_ref[0, :, h].astype(jnp.float32)                       # (S, D)
+    group = n_heads // n_kv_heads
+    # one (group, D) x (D, S) matmul per KV head: the q heads sharing a KV
+    # head batch into one MXU op, and each K/V panel is converted/read once
+    for kv_h in range(n_kv_heads):
+        q = q_ref[0, 0, kv_h * group:(kv_h + 1) * group].astype(
+            jnp.float32) * scale                                  # (G, D)
+        k = k_ref[0, :, kv_h].astype(jnp.float32)                 # (S, D)
+        v = v_ref[0, :, kv_h].astype(jnp.float32)                 # (S, D)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (1, S)
+                                preferred_element_type=jnp.float32)  # (G, S)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(k_pos < L, s, NEG_INF)
         m = s.max(axis=-1, keepdims=True)
@@ -60,20 +65,23 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads):
         denom = e.sum(axis=-1, keepdims=True)
         o = jax.lax.dot_general(e, v, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32) / denom
-        o_ref[0, 0, h] = o[0].astype(o_ref.dtype)
+        o_ref[0, 0, kv_h * group:(kv_h + 1) * group] = o.astype(o_ref.dtype)
 
 
 def _pallas_decode(q, k_cache, v_cache, lengths, *, scale, interpret):
     B, _, H, D = q.shape
-    S = k_cache.shape[1]
+    S, KV = k_cache.shape[1], k_cache.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads {H} must be a multiple of KV heads {KV}")
     return pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, n_heads=H),
+        functools.partial(_decode_kernel, scale=scale, n_heads=H,
+                          n_kv_heads=KV),
         grid=(B,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # lengths (B,), whole
             pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
-            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
-            pl.BlockSpec((1, S, H, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, KV, D), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((1, S, KV, D), lambda b: (b, 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, H, D), lambda b: (b, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
@@ -113,8 +121,10 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     """One decode tick.
 
     ``q``: ``(B, 1, H, D)`` — the new token's query.
-    ``k_cache``/``v_cache``: ``(B, S_max, H, D)`` — cache AFTER appending
-    the new K/V (model cache layout).
+    ``k_cache``/``v_cache``: ``(B, S_max, KV, D)`` — cache AFTER appending
+    the new K/V (model cache layout).  ``KV`` may be smaller than ``H``
+    (GQA/MQA: q head ``h`` reads KV head ``h // (H/KV)`` — no repeated
+    panels in HBM or VMEM).
     ``length``: int scalar or ``(B,)`` — number of valid cache slots per
     row (``cur + 1``).
 

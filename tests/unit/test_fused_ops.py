@@ -203,3 +203,39 @@ def test_fused_mlp_spmd_on_mesh():
         assert fused_mlp_spmd(x, w1, b1, w2, b2, interpret=True) is None
     finally:
         mesh_mod.set_mesh(None)
+
+
+def test_decode_attention_gqa_matches_repeated_reference():
+    """GQA decode: KV cache holds fewer heads; q head h reads KV head
+    h // (H/KV).  Must equal the repeat-then-attend reference."""
+    rng = np.random.default_rng(5)
+    B, S, H, KV, D = 2, 32, 8, 2, 64
+    L = 17
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, KV, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KV, D)), jnp.float32)
+
+    out = decode_attention(q, k, v, L, interpret=True)
+
+    rep = H // KV
+    k_rep = jnp.repeat(k, rep, axis=2)
+    v_rep = jnp.repeat(v, rep, axis=2)
+    ref = decode_attention(q, k_rep, v_rep, L, interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    # per-row lengths with GQA shapes
+    lengths = jnp.asarray([5, 29])
+    out_rows = decode_attention(q, k, v, lengths, interpret=True)
+    ref_rows = decode_attention(q, k_rep, v_rep, lengths, interpret=True)
+    np.testing.assert_allclose(out_rows, ref_rows, rtol=1e-5, atol=1e-5)
+
+    # vmapped (continuous-batching) dispatch with GQA shapes
+    out_v = jax.vmap(lambda qq, kk, vv, ll: decode_attention(
+        qq, kk, vv, ll, interpret=True))(
+        q[:, None], k[:, None], v[:, None], lengths[:, None])
+    np.testing.assert_allclose(out_v[:, 0], out_rows, rtol=1e-5, atol=1e-5)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        decode_attention(q, k[:, :, [0, 0, 0]], v[:, :, [0, 0, 0]], L,
+                         interpret=True)  # KV=3 does not divide H=8
