@@ -171,10 +171,11 @@ class SelfAttention(nn.Module):
                 cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
             idx.value = cur + S
             k_full, v_full = ck.value, cv.value
-            from ..ops.pallas.decode_attention import fits_vmem
+            from ..ops.pallas.decode_attention import decode_supported
 
             if S == 1 and attn_mask is None and on_tpu() and \
-                    fits_vmem(cfg.n_positions, H, D, k_full.dtype.itemsize):
+                    decode_supported(cfg.n_positions, H, D,
+                                     k_full.dtype.itemsize):
                 # single-token tick → fused KV-cache kernel (the
                 # softmax_context analog, ops/pallas/decode_attention.py)
                 from ..ops.pallas.decode_attention import decode_attention

@@ -128,11 +128,11 @@ class LlamaAttention(nn.Module):
             k_full, v_full = ck.value, cv.value
             from ..ops.attention import on_tpu
             from ..ops.pallas.decode_attention import (decode_attention,
-                                                       fits_vmem)
+                                                       decode_supported)
 
             if S == 1 and attn_mask is None and on_tpu() and \
-                    fits_vmem(cfg.max_position_embeddings, KV, D,
-                              k_full.dtype.itemsize):
+                    decode_supported(cfg.max_position_embeddings, KV, D,
+                                     k_full.dtype.itemsize):
                 # single-token tick → fused GQA decode kernel (KV panels
                 # stay at KV heads — no repeat materialized)
                 y = decode_attention(q, k_full, v_full, cur + 1)

@@ -17,8 +17,12 @@ folds any vmapped axis into the kernel's batch grid, so a slot-vmapped
 decode step (``inference/serving.py``) runs ONE batched kernel instead of
 tripping Pallas' auto-batching on the SMEM operand.
 
-Callers should keep the cache panel within VMEM (see ``fits_vmem``);
-the model dispatch falls back to the XLA path otherwise.
+Caches whose whole K/V panel fits VMEM (see ``fits_vmem``) use the
+single-panel kernel; larger caches stream KV blocks through a second
+grid dimension with the online-softmax state in VMEM scratch
+(flash-decode), skipping blocks wholly past the live prefix.  Model
+dispatch gates on ``decode_supported`` (practically always true) and
+falls back to the XLA path only for exotic shapes.
 
 ``interpret=True`` runs on CPU for tests.
 """
@@ -39,10 +43,36 @@ NEG_INF = float("-inf")
 # other half for q/out/f32 head slices.  Measured: fp32 (1024,12,64)
 # panels (2x6.3MB after double-buffering) overflow by 440KB.
 _VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+_DECODE_BLOCK_S = 1024   # KV-block length for the streamed (long-S) path
 
 
 def fits_vmem(s: int, h: int, d: int, itemsize: int) -> bool:
     return 2 * s * h * d * itemsize <= _VMEM_BUDGET_BYTES
+
+
+def _pick_block(s: int, kv_heads: int, d: int, itemsize: int) -> int:
+    """Largest power-of-two KV block <= min(s, 1024) whose double-buffered
+    K+V panels fit the VMEM budget; 0 if even a 128-block doesn't fit.
+    No divisibility requirement — the ragged last block is padded by
+    Pallas and its garbage positions fall outside ``k_pos < L``."""
+    blk = _DECODE_BLOCK_S
+    while blk > s:
+        blk //= 2
+    blk = max(blk, 1)
+    while blk >= 128:
+        if fits_vmem(blk, kv_heads, d, itemsize):
+            return blk
+        blk //= 2
+    # tiny caches (s < 128): allow the exact size if it fits
+    return s if s < 128 and fits_vmem(s, kv_heads, d, itemsize) else 0
+
+
+def decode_supported(s: int, kv_heads: int, d: int, itemsize: int) -> bool:
+    """True when SOME decode-kernel path handles a cache of length ``s``:
+    either the whole panel fits VMEM, or a streamed KV block does (the
+    flash-decode online-softmax path)."""
+    return fits_vmem(s, kv_heads, d, itemsize) or \
+        _pick_block(s, kv_heads, d, itemsize) > 0
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads,
@@ -68,11 +98,91 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, n_heads,
         o_ref[0, 0, kv_h * group:(kv_h + 1) * group] = o.astype(o_ref.dtype)
 
 
+def _decode_kernel_blocked(len_ref, q_ref, k_ref, v_ref, o_ref,
+                           acc_ref, m_ref, l_ref, *, scale, n_heads,
+                           n_kv_heads, block_s, n_blocks):
+    """Streamed long-S decode (flash-decode): grid dim 1 walks KV blocks
+    delivered from HBM; the online-softmax state (acc/m/l) lives in VMEM
+    scratch, persisting across the sequential inner grid steps."""
+    L = len_ref[pl.program_id(0)]
+    j = pl.program_id(1)
+    group = n_heads // n_kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_s < L)   # blocks wholly past the live prefix: skip
+    def _attend():
+        for kv_h in range(n_kv_heads):
+            sl = pl.ds(kv_h * group, group)
+            q = q_ref[0, 0, sl].astype(jnp.float32) * scale      # (G, D)
+            k = k_ref[0, :, kv_h].astype(jnp.float32)            # (blk, D)
+            v = v_ref[0, :, kv_h].astype(jnp.float32)
+            # the ragged last block reads past S: its garbage k columns are
+            # masked below, but garbage v rows must be ZEROED — p is 0
+            # there, and 0 * NaN/inf would still poison the p @ v matmul
+            row_pos = j * block_s + jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0)
+            v = jnp.where(row_pos < L, v, 0.0)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)  # (G, blk)
+            # masks both the live-length cutoff AND the padded ragged tail
+            k_pos = j * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < L, s, NEG_INF)
+            m_old = m_ref[sl, 0]                                 # (G,)
+            m_new = jnp.maximum(m_old, s.max(axis=-1))
+            m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+            p = jnp.exp(s - m_safe[:, None])
+            corr = jnp.where(m_old == NEG_INF, 0.0, jnp.exp(m_old - m_safe))
+            l_ref[sl, 0] = l_ref[sl, 0] * corr + p.sum(axis=-1)
+            acc_ref[sl, :] = acc_ref[sl, :] * corr[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[sl, 0] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
 def _pallas_decode(q, k_cache, v_cache, lengths, *, scale, interpret):
     B, _, H, D = q.shape
     S, KV = k_cache.shape[1], k_cache.shape[2]
     if H % KV:
         raise ValueError(f"q heads {H} must be a multiple of KV heads {KV}")
+    itemsize = k_cache.dtype.itemsize
+    if not fits_vmem(S, KV, D, itemsize):
+        # stream the cache in KV blocks (flash-decode)
+        blk = _pick_block(S, KV, D, itemsize)
+        if blk <= 0:
+            raise ValueError(
+                f"no VMEM-fitting KV block for cache ({S}, {KV}, {D}); "
+                "use the XLA attention path")
+        n_blocks = -(-S // blk)   # ceil: ragged last block padded+masked
+        return pl.pallas_call(
+            functools.partial(_decode_kernel_blocked, scale=scale, n_heads=H,
+                              n_kv_heads=KV, block_s=blk, n_blocks=n_blocks),
+            grid=(B, n_blocks),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, H, D), lambda b, j: (b, 0, 0, 0)),
+                pl.BlockSpec((1, blk, KV, D), lambda b, j: (b, j, 0, 0)),
+                pl.BlockSpec((1, blk, KV, D), lambda b, j: (b, j, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, H, D), lambda b, j: (b, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((H, D), jnp.float32),     # acc
+                pltpu.VMEM((H, 128), jnp.float32),   # m (col 0 used)
+                pltpu.VMEM((H, 128), jnp.float32),   # l (col 0 used)
+            ],
+            interpret=interpret,
+        )(lengths, q, k_cache, v_cache)
     return pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, n_heads=H,
                           n_kv_heads=KV),
