@@ -164,23 +164,37 @@ def _pallas_decode(q, k_cache, v_cache, lengths, *, scale, interpret):
                 f"no VMEM-fitting KV block for cache ({S}, {KV}, {D}); "
                 "use the XLA attention path")
         n_blocks = -(-S // blk)   # ceil: ragged last block padded+masked
-        return pl.pallas_call(
-            functools.partial(_decode_kernel_blocked, scale=scale, n_heads=H,
-                              n_kv_heads=KV, block_s=blk, n_blocks=n_blocks),
+
+        # lengths ride as SCALAR PREFETCH so the k/v index maps can clamp
+        # dead blocks (wholly past the live prefix) to the last live block
+        # — the DMA re-fetches an already-resident block instead of
+        # streaming S_max/L x useless HBM traffic; pl.when skips their
+        # compute
+        def _kv_index(b, j, len_ref):
+            jmax = (len_ref[b] + blk - 1) // blk - 1
+            return (b, jnp.minimum(j, jmax), 0, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=(B, n_blocks),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec((1, 1, H, D), lambda b, j: (b, 0, 0, 0)),
-                pl.BlockSpec((1, blk, KV, D), lambda b, j: (b, j, 0, 0)),
-                pl.BlockSpec((1, blk, KV, D), lambda b, j: (b, j, 0, 0)),
+                pl.BlockSpec((1, 1, H, D), lambda b, j, len_ref: (b, 0, 0, 0)),
+                pl.BlockSpec((1, blk, KV, D), _kv_index),
+                pl.BlockSpec((1, blk, KV, D), _kv_index),
             ],
-            out_specs=pl.BlockSpec((1, 1, H, D), lambda b, j: (b, 0, 0, 0)),
-            out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+            out_specs=pl.BlockSpec((1, 1, H, D),
+                                   lambda b, j, len_ref: (b, 0, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((H, D), jnp.float32),     # acc
                 pltpu.VMEM((H, 128), jnp.float32),   # m (col 0 used)
                 pltpu.VMEM((H, 128), jnp.float32),   # l (col 0 used)
             ],
+        )
+        return pl.pallas_call(
+            functools.partial(_decode_kernel_blocked, scale=scale, n_heads=H,
+                              n_kv_heads=KV, block_s=blk, n_blocks=n_blocks),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
             interpret=interpret,
         )(lengths, q, k_cache, v_cache)
     return pl.pallas_call(

@@ -68,10 +68,11 @@ def test_autotuner_kernel_options_space():
                           remat_options=[False])
         assert {} in tuner.kernel_options
         assert {"fused_mlp": True} in tuner.kernel_options
+        assert {"scan_layers": False} in tuner.kernel_options
         cfg = tuner.tune()
         kernels_probed = {tuple(sorted(r.config_overrides["kernel"].items()))
                           for r in tuner.results}
-        assert len(kernels_probed) == 2
+        assert len(kernels_probed) == 3
         assert "autotuned" in cfg
     finally:
         mesh_mod.set_mesh(None)
