@@ -55,9 +55,10 @@ def bench_decode():
     prompts = [rng.integers(0, cfg.vocab_size, size=(32,)).astype(np.int32)
                for _ in range(slots * 2)]
     batcher = ContinuousBatcher(eng, n_slots=slots)
-    batcher.run(prompts[:slots], max_new_tokens=4)       # warmup/compile
+    ticks = 16   # decode ticks per host round-trip (tunnel RTT dominates)
+    batcher.run(prompts[:slots], max_new_tokens=4, ticks=ticks)  # warmup
     t0 = time.perf_counter()
-    outs = batcher.run(prompts, max_new_tokens=new_toks)
+    outs = batcher.run(prompts, max_new_tokens=new_toks, ticks=ticks)
     dt = time.perf_counter() - t0
     tokens = sum(len(o) - 32 for o in outs)
     print(json.dumps({

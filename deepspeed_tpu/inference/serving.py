@@ -20,6 +20,7 @@ reused, so the hot loop never recompiles or reshapes.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -111,9 +112,33 @@ class ContinuousBatcher:
             seen = seen.at[jnp.arange(1), nxt].set(True)
             return nxt, vars_["cache"], seen, new_done
 
-        self._step_fn = jax.jit(jax.vmap(
+        self._vmapped_step = jax.vmap(
             slot_step,
-            in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None)))
+            in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None))
+
+        # N ticks per host round-trip: a lax.scan over the vmapped tick,
+        # emitting (ticks, slots) tokens in ONE device fetch — the lever
+        # for high-RTT links where each sync costs a round trip
+        @functools.lru_cache(maxsize=None)   # executables are cheap vs a
+        def multi_step(ticks: int):          # recompile on tunneled links
+            vstep = self._vmapped_step
+
+            def run(params, cache, token, pos, slot_ids, temp, top_p, rep,
+                    seen, done, tick0, eos, pad):
+                def body(carry, t):
+                    cache, token, pos, seen, done = carry
+                    tok, cache, seen, done = vstep(
+                        params, cache, token, pos, slot_ids, temp, top_p,
+                        rep, seen, done, tick0 + t, eos, pad)
+                    return (cache, tok[:, :, None], pos + 1, seen, done), tok
+                (cache, token, pos, seen, done), toks = jax.lax.scan(
+                    body, (cache, token, pos, seen, done),
+                    jnp.arange(ticks))
+                return toks, cache, token, pos, seen, done
+
+            return jax.jit(run)
+
+        self._multi_step = multi_step
 
         # admission: ONE jitted scatter of the new slot's cache + sampling
         # state, with the slot index TRACED (a python-int index would bake
@@ -233,38 +258,46 @@ class ContinuousBatcher:
         self._done = self._set_done(self._done, i)
 
     # ------------------------------------------------------------------
-    def step(self) -> Dict[int, np.ndarray]:
-        """Admit queued requests, run ONE decode tick for every active
-        slot, retire finished ones.  Returns {uid: full token array} for
-        requests that completed during this call."""
+    def step(self, ticks: int = 1) -> Dict[int, np.ndarray]:
+        """Admit queued requests, run ``ticks`` decode ticks for every
+        active slot (one host round-trip total), retire finished ones.
+        For the rest of a window, an EOS-finished slot emits pad (its
+        device ``done`` flag froze it); a slot finished by its
+        max_new_tokens count keeps computing real tokens on-device — the
+        host discards them and the slot's state is overwritten at the
+        next admission.  Returns {uid: full token array} for requests
+        that completed during this call."""
+        if ticks < 1:
+            raise ValueError(f"ticks must be >= 1, got {ticks}")
         before = set(self._finished)
         self._admit()
         if any(s is not None for s in self._slots):
             slot_ids = jnp.arange(self.n_slots)
-            tok, self._cache, self._seen, done = self._step_fn(
-                self.engine.params, self._cache, self._token, self._pos,
-                slot_ids, self._temp, self._top_p, self._rep, self._seen,
-                self._done, jnp.int32(self._tick_no), jnp.int32(self.eos),
-                jnp.int32(self.pad))
-            self._tick_no += 1
-            self._token = tok[:, :, None]
-            self._pos = self._pos + 1
-            tok_h = np.asarray(jax.device_get(tok))[:, 0]
-            done_h = np.asarray(jax.device_get(done))[:, 0]
+            toks, self._cache, self._token, self._pos, self._seen, done = \
+                self._multi_step(int(ticks))(
+                    self.engine.params, self._cache, self._token, self._pos,
+                    slot_ids, self._temp, self._top_p, self._rep, self._seen,
+                    self._done, jnp.int32(self._tick_no), jnp.int32(self.eos),
+                    jnp.int32(self.pad))
+            self._tick_no += int(ticks)
             self._done = done
-            for i, act in enumerate(self._slots):
-                if act is None:
-                    continue
-                act.emitted.append(int(tok_h[i]))
-                if done_h[i] or len(act.emitted) >= act.req.max_new_tokens:
-                    self._retire(i)
+            tok_h = np.asarray(jax.device_get(toks))[:, :, 0]  # (ticks, slots)
+            for t in range(int(ticks)):
+                for i, act in enumerate(self._slots):
+                    if act is None:
+                        continue
+                    tokv = int(tok_h[t, i])
+                    act.emitted.append(tokv)
+                    if (self.eos >= 0 and tokv == self.eos) or \
+                            len(act.emitted) >= act.req.max_new_tokens:
+                        self._retire(i)
         new = {u: self._finished[u] for u in self._finished if u not in before}
         return new
 
-    def run(self, prompts, **gen_kwargs) -> List[np.ndarray]:
+    def run(self, prompts, ticks: int = 1, **gen_kwargs) -> List[np.ndarray]:
         """Convenience: submit every prompt, step until drained, return
         outputs in submission order."""
         uids = [self.submit(p, **gen_kwargs) for p in prompts]
         while any(u not in self._finished for u in uids):
-            self.step()
+            self.step(ticks=ticks)
         return [self._finished[u] for u in uids]

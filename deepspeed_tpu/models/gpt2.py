@@ -170,26 +170,12 @@ class SelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
             idx.value = cur + S
-            k_full, v_full = ck.value, cv.value
-            from ..ops.pallas.decode_attention import decode_supported
+            # fused-or-fallback dispatch shared by all decoder families
+            # (the softmax_context analog, ops/pallas/decode_attention.py)
+            from ..ops.attention import cached_decode_attention
 
-            if S == 1 and attn_mask is None and on_tpu() and \
-                    decode_supported(cfg.n_positions, H, D,
-                                     k_full.dtype.itemsize):
-                # single-token tick → fused KV-cache kernel (the
-                # softmax_context analog, ops/pallas/decode_attention.py)
-                from ..ops.pallas.decode_attention import decode_attention
-
-                y = decode_attention(q, k_full, v_full, cur + 1)
-            else:
-                # position t may attend cache slots <= cur + t
-                q_pos = cur + jnp.arange(S)[:, None]
-                k_pos = jnp.arange(cfg.n_positions)[None, :]
-                mask = (k_pos <= q_pos)[None, None, :, :]
-                if attn_mask is not None:
-                    mask = jnp.logical_and(mask, attn_mask)
-                y = dot_product_attention(q, k_full, v_full, causal=False,
-                                          mask=mask, impl="jnp")
+            y = cached_decode_attention(q, ck.value, cv.value, cur,
+                                        attn_mask)
             y = y.reshape(B, S, E)
             out = _dense(y, E, ("heads", "embed"), cfg=cfg, name="c_proj", module=self,
                          init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)

@@ -134,11 +134,11 @@ class NeoXAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
             idx.value = cur + S
-            q_pos = cur + jnp.arange(S)[:, None]
-            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
-            mask = (k_pos <= q_pos)[None, None, :, :]
-            y = dot_product_attention(q, ck.value, cv.value, causal=False,
-                                      mask=mask, impl="jnp")
+            # shared fused-or-fallback dispatch (ops/attention.py)
+            from ..ops.attention import cached_decode_attention
+
+            y = cached_decode_attention(q, ck.value, cv.value, cur,
+                                        attn_mask)
         else:
             y = dot_product_attention(q, k, v, causal=True, mask=attn_mask,
                                       impl=cfg.attn_impl)

@@ -125,34 +125,22 @@ class LlamaAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
             idx.value = cur + S
-            k_full, v_full = ck.value, cv.value
-            from ..ops.attention import on_tpu
-            from ..ops.pallas.decode_attention import (decode_attention,
-                                                       decode_supported)
+            # shared fused-or-fallback dispatch; GQA-aware (KV panels stay
+            # at KV heads on the kernel path — no repeat materialized)
+            from ..ops.attention import cached_decode_attention
 
-            if S == 1 and attn_mask is None and on_tpu() and \
-                    decode_supported(cfg.max_position_embeddings, KV, D,
-                                     k_full.dtype.itemsize):
-                # single-token tick → fused GQA decode kernel (KV panels
-                # stay at KV heads — no repeat materialized)
-                y = decode_attention(q, k_full, v_full, cur + 1)
-                y = y.reshape(B, S, H * D)
-                return _dense(y, E, ("heads", "embed"), cfg=cfg,
-                              name="o_proj", module=self)
-            q_pos = cur + jnp.arange(S)[:, None]
-            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
-            mask = (k_pos <= q_pos)[None, None, :, :]
-            if attn_mask is not None:   # padded batches: AND the user mask
-                mask = jnp.logical_and(mask, attn_mask)
-            causal = False
-        else:
-            k_full, v_full, mask, causal = k, v, attn_mask, True
+            y = cached_decode_attention(q, ck.value, cv.value, cur,
+                                        attn_mask)
+            y = y.reshape(B, S, H * D)
+            return _dense(y, E, ("heads", "embed"), cfg=cfg,
+                          name="o_proj", module=self)
+        k_full, v_full = k, v
         if KV != H:  # GQA: repeat kv heads
             rep = H // KV
             k_full = jnp.repeat(k_full, rep, axis=2)
             v_full = jnp.repeat(v_full, rep, axis=2)
-        y = dot_product_attention(q, k_full, v_full, causal=causal, mask=mask,
-                                  impl=cfg.attn_impl if not cfg.decode else "jnp")
+        y = dot_product_attention(q, k_full, v_full, causal=True,
+                                  mask=attn_mask, impl=cfg.attn_impl)
         y = y.reshape(B, S, H * D)
         return _dense(y, E, ("heads", "embed"), cfg=cfg, name="o_proj", module=self)
 

@@ -110,6 +110,39 @@ def _flash_spmd(q, k, v, *, causal, scale, interpret=False, flash_opts=None):
         return None
 
 
+def cached_decode_attention(q, k_cache, v_cache, cur, attn_mask=None, *,
+                            scale=None):
+    """Attention over an appended KV cache (decode mode) — the ONE
+    dispatch shared by every decoder family (gpt2/llama/gptj/neox):
+    single-token ticks ride the fused Pallas kernel when supported
+    (GQA-aware — ``k_cache`` may hold fewer heads than ``q``), otherwise
+    a masked jnp attention over positions ``<= cur + t``.
+
+    ``q``: (B, S, H, D) new queries; ``k_cache``/``v_cache``:
+    (B, S_max, KV, D) caches AFTER the append; ``cur``: scalar cache
+    index before the append.
+    """
+    B, S, H, D = q.shape
+    S_max, KV = k_cache.shape[1], k_cache.shape[2]
+    from .pallas.decode_attention import decode_attention, decode_supported
+
+    if S == 1 and attn_mask is None and on_tpu() and \
+            decode_supported(S_max, KV, D, k_cache.dtype.itemsize):
+        return decode_attention(q, k_cache, v_cache, cur + 1, scale=scale)
+    if KV != H:   # GQA fallback: repeat KV heads for the dense path
+        rep = H // KV
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    q_pos = cur + jnp.arange(S)[:, None]
+    k_pos = jnp.arange(S_max)[None, :]
+    mask = (k_pos <= q_pos)[None, None, :, :]
+    if attn_mask is not None:
+        mask = jnp.logical_and(mask, attn_mask)
+    return _jnp_attention(q, k_cache, v_cache, causal=False, bias=None,
+                          mask=mask, dropout_rate=0.0, dropout_rng=None,
+                          scale=scale)
+
+
 def sp_flash_spec(mesh, batch_size: int, heads: int):
     """PartitionSpec for running the flash ring engine under a FULL-manual
     shard_map when ``sp`` coexists with other active mesh axes: batch over
