@@ -258,6 +258,13 @@ class InferenceEngine:
         repetition penalty, ``done`` (B,) freezes finished sequences (they
         emit ``pad_id`` from then on), ``eos_id`` < 0 disables EOS.
         """
+        tick = self._decode_tick(top_k, top_p, temperature)
+        return jax.jit(tick)
+
+    def _decode_tick(self, top_k: int, top_p: float, temperature: float):
+        """ONE decode tick as a pure function — the single source of truth
+        shared by the stepwise jit and the scanned loop (their
+        token-for-token equivalence is structural, not copy-kept)."""
 
         def step(params, cache, token, position, rng,
                  rep_penalty, seen_mask, done, eos_id, pad_id):
@@ -273,7 +280,32 @@ class InferenceEngine:
             seen_mask = seen_mask.at[jnp.arange(B), next_token].set(True)
             return next_token, vars_["cache"], seen_mask, new_done
 
-        return jax.jit(step)
+        return step
+
+    @functools.lru_cache(maxsize=16)
+    def _compiled_generate_loop(self, top_k: int, top_p: float,
+                                temperature: float):
+        """The WHOLE decode loop as one ``lax.scan`` program: n tokens per
+        host round-trip instead of one (the loop version pays an RTT per
+        token on remote links).  Token-for-token identical to the stepwise
+        path — same tick function, same RNG split order."""
+        tick = self._decode_tick(top_k, top_p, temperature)
+
+        def run(params, cache, token, pos0, rng, rep_penalty, seen_mask,
+                done, eos_id, pad_id, steps):
+            def body(carry, t):
+                cache, token, seen, done, rng = carry
+                rng, sub = jax.random.split(rng)
+                nxt, cache, seen, done = tick(
+                    params, cache, token, (pos0 + t)[:, None], sub,
+                    rep_penalty, seen, done, eos_id, pad_id)
+                return (cache, nxt[:, None], seen, done, rng), nxt
+
+            (_, _, _, _, _), toks = jax.lax.scan(
+                body, (cache, token, seen_mask, done, rng), steps)
+            return toks   # (n, B)
+
+        return jax.jit(run)
 
     @staticmethod
     def _seen_mask_from(input_ids, vocab_size: int):
@@ -295,14 +327,21 @@ class InferenceEngine:
                  top_k: int = 0, top_p: float = 1.0,
                  repetition_penalty: float = 1.0, seed: int = 0,
                  eos_token_id: Optional[int] = None,
-                 pad_token_id: Optional[int] = None):
-        """Autoregressive generation: compiled prefill + compiled decode step.
+                 pad_token_id: Optional[int] = None,
+                 compiled_loop: bool = True):
+        """Autoregressive generation: compiled prefill + compiled decode.
 
         Greedy when ``temperature == 0``; ``top_p`` nucleus and
         ``repetition_penalty`` follow the HF semantics.  Sequences that
         emit ``eos_token_id`` are frozen individually and padded with
-        ``pad_token_id`` (default: the EOS id); generation stops early
-        when every sequence is done.  Returns (B, S+max_new_tokens).
+        ``pad_token_id`` (default: the EOS id).
+
+        ``compiled_loop=True`` (default) runs the whole decode loop as ONE
+        compiled ``lax.scan`` — a single host round-trip for all tokens;
+        output is always (B, S+max_new_tokens).  ``compiled_loop=False``
+        steps tick-by-tick and stops early once every sequence is done
+        (possibly returning fewer columns) — saves compute when EOS lands
+        early, pays a round-trip per token.
         """
         if self.params is None:
             raise RuntimeError("no parameters loaded; pass params=/checkpoint=")
@@ -324,8 +363,6 @@ class InferenceEngine:
         vocab = logits.shape[-1]
         seen = self._seen_mask_from(input_ids, vocab)
         done = jnp.zeros((B,), bool)
-        decode_step = self._compiled_decode_step(
-            int(top_k), float(top_p), float(temperature))
 
         rng, sub = jax.random.split(rng)
         token = _sample(logits[:, -1, :].astype(jnp.float32), sub,
@@ -333,6 +370,15 @@ class InferenceEngine:
                         rep_pen, seen)
         done = token == eos
         seen = seen.at[jnp.arange(B), token].set(True)
+        if compiled_loop and max_new_tokens > 1:
+            loop = self._compiled_generate_loop(
+                int(top_k), float(top_p), float(temperature))
+            toks = loop(self.params, cache, token[:, None],
+                        jnp.full((B,), S, jnp.int32), rng, rep_pen, seen,
+                        done, eos, pad, jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate([input_ids, token[:, None], toks.T], axis=1)
+        decode_step = self._compiled_decode_step(
+            int(top_k), float(top_p), float(temperature))
         tokens = [token]
         pos = S
         for _ in range(max_new_tokens - 1):

@@ -288,3 +288,29 @@ def test_continuous_batcher_multi_tick_matches_single():
     out_m = multi.run(prompts, ticks=3, max_new_tokens=7)   # retires mid-window
     for a, b in zip(out_s, out_m):
         np.testing.assert_array_equal(a, b)
+
+
+def test_generate_compiled_loop_matches_stepwise():
+    """The one-scan decode loop must be token-for-token identical to the
+    tick-by-tick path (same RNG split order), greedy and sampled."""
+    eng = _tiny_engine()
+    ids = np.random.default_rng(31).integers(0, 512, size=(2, 5)).astype(np.int32)
+    for kw in (dict(),
+               dict(temperature=0.8, top_k=7, top_p=0.9,
+                    repetition_penalty=1.1, seed=13)):
+        a = np.asarray(eng.generate(ids, max_new_tokens=6,
+                                    compiled_loop=True, **kw))
+        b = np.asarray(eng.generate(ids, max_new_tokens=6,
+                                    compiled_loop=False, **kw))
+        np.testing.assert_array_equal(a, b)
+
+    # with EOS: the scan path returns FULL width (pads after eos); the
+    # stepwise path may stop early — prefixes must agree
+    free = np.asarray(eng.generate(ids, max_new_tokens=8))
+    eos = int(free[0, 6])
+    full = np.asarray(eng.generate(ids, max_new_tokens=8, eos_token_id=eos,
+                                   pad_token_id=0, compiled_loop=True))
+    short = np.asarray(eng.generate(ids, max_new_tokens=8, eos_token_id=eos,
+                                    pad_token_id=0, compiled_loop=False))
+    assert full.shape == (2, 13)
+    np.testing.assert_array_equal(full[:, :short.shape[1]], short)
