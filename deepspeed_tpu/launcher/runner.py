@@ -167,8 +167,8 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
             if pr.poll() is None:
                 pr.terminate()
 
-    signal.signal(signal.SIGINT, _on_signal)
-    signal.signal(signal.SIGTERM, _on_signal)
+    prev_int = signal.signal(signal.SIGINT, _on_signal)
+    prev_term = signal.signal(signal.SIGTERM, _on_signal)
     monitor = HeartbeatMonitor(hb_files, args.heartbeat_timeout) \
         if hb_files else None
     rc = 0
@@ -196,6 +196,11 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
             time.sleep(0.2)
         _reap(procs)
     finally:
+        # restore the caller's handlers — the launcher may be invoked
+        # programmatically (restart loop, tests); leaking ours would
+        # swallow the host process's Ctrl-C forever
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
         if hb_dir:
             import shutil
 

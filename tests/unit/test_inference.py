@@ -314,3 +314,18 @@ def test_generate_compiled_loop_matches_stepwise():
                                     pad_token_id=0, compiled_loop=False))
     assert full.shape == (2, 13)
     np.testing.assert_array_equal(full[:, :short.shape[1]], short)
+
+
+def test_continuous_batcher_idle_and_immediate_finish():
+    """Edge cases: step() with nothing queued is a no-op; a request whose
+    budget is a single token retires at admission."""
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    b = ContinuousBatcher(eng, n_slots=2)
+    assert b.step() == {} and b.pending == 0
+    uid = b.submit(np.asarray([3, 1, 4], np.int32), max_new_tokens=1)
+    done = b.step()
+    assert uid in done and len(done[uid]) == 4
+    assert b.pending == 0
+    with pytest.raises(ValueError):
+        b.step(ticks=0)

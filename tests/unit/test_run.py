@@ -183,3 +183,25 @@ def test_dstpu_ssh_parses_and_reports(tmp_path, monkeypatch):
     assert all(c[-1] == "grep 'foo bar'" for c in calls)
     # bad hostfile: clean error, no traceback
     assert tools.ssh_main(["--hostfile", "/no/such/file", "uptime"]) == 1
+
+
+def test_launcher_restart_recovers_transient_failure(tmp_path):
+    """A worker that fails on the first attempt and succeeds on the
+    second must end with rc=0 under --max_restarts (the automated
+    relaunch+resume model)."""
+    import textwrap
+
+    from deepspeed_tpu.launcher.runner import main
+
+    marker = tmp_path / "ran_once"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            sys.exit(3)          # transient failure on first attempt
+        sys.exit(0)
+    """))
+    rc = main(["--num_processes", "1", "--max_restarts", "2", str(script)])
+    assert rc == 0
