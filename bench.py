@@ -68,7 +68,11 @@ def bench_decode():
 
 
 def main():
-    if "--mode" in sys.argv and "decode" in sys.argv:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=["train", "decode"], default="train")
+    cli, _ = ap.parse_known_args()
+    if cli.mode == "decode":
         return bench_decode()
     import jax
     import jax.numpy as jnp

@@ -1,18 +1,25 @@
 // Native CPU optimizer kernels for host-offloaded optimizer states.
 //
-// TPU-native analog of the reference's AVX-vectorized CPU Adam/Adagrad
-// (csrc/adam/cpu_adam.cpp, csrc/adagrad/cpu_adagrad.cpp, simd.h): used by
-// the ZeRO-Offload path where fp32 master params + Adam moments live in
-// host RAM and the update runs on CPU while the device holds only bf16
-// weights.  Vectorization is left to the compiler (-O3 -march=native
-// -ffast-math auto-vectorizes these straight-line loops the same way the
-// reference's hand-written AVX512/AVX256 intrinsics do).
+// TPU-native analog of the reference's AVX-vectorized, OpenMP-parallel
+// CPU Adam/Adagrad (csrc/adam/cpu_adam.cpp, csrc/includes/cpu_adam.h:171,
+// csrc/adagrad/cpu_adagrad.cpp, simd.h): used by the ZeRO-Offload path
+// where fp32 master params + Adam moments live in host RAM and the update
+// runs on CPU while the device holds only bf16 weights.  Vectorization is
+// left to the compiler (-O3 -march=native -ffast-math auto-vectorizes
+// these straight-line loops the same way the reference's hand-written
+// AVX512/AVX256 intrinsics do); thread parallelism is OpenMP
+// (`parallel for simd`, matching the reference's #pragma omp parallel
+// for), engaged only past OMP_MIN_N elements so small shards stay serial.
+// Thread count follows OMP_NUM_THREADS.
 //
 // C ABI for ctypes; all buffers are contiguous fp32 (or fp32 grads
 // upcast by the caller).
 
 #include <cmath>
 #include <cstdint>
+
+// below this, fork/join overhead beats the work (one cache-resident pass)
+static const int64_t OMP_MIN_N = 1 << 16;
 
 extern "C" {
 
@@ -24,7 +31,7 @@ void ds_adam_step(float* params, const float* grads, float* exp_avg,
                   float bias_c2, int adamw_mode) {
   const float step_size = lr / bias_c1;
   const float inv_sqrt_bc2 = 1.0f / std::sqrt(bias_c2);
-#pragma omp simd
+#pragma omp parallel for simd schedule(static) if (n > OMP_MIN_N)
   for (int64_t i = 0; i < n; ++i) {
     float g = grads[i];
     if (!adamw_mode && weight_decay != 0.0f) g += weight_decay * params[i];
@@ -44,7 +51,7 @@ void ds_adam_step(float* params, const float* grads, float* exp_avg,
 
 void ds_adagrad_step(float* params, const float* grads, float* exp_avg_sq,
                      int64_t n, float lr, float eps, float weight_decay) {
-#pragma omp simd
+#pragma omp parallel for simd schedule(static) if (n > OMP_MIN_N)
   for (int64_t i = 0; i < n; ++i) {
     float g = grads[i];
     if (weight_decay != 0.0f) g += weight_decay * params[i];
@@ -57,7 +64,7 @@ void ds_adagrad_step(float* params, const float* grads, float* exp_avg_sq,
 // Flat SGD w/ momentum for completeness of the host-offload family.
 void ds_sgd_step(float* params, const float* grads, float* momentum_buf,
                  int64_t n, float lr, float momentum, float weight_decay) {
-#pragma omp simd
+#pragma omp parallel for simd schedule(static) if (n > OMP_MIN_N)
   for (int64_t i = 0; i < n; ++i) {
     float g = grads[i];
     if (weight_decay != 0.0f) g += weight_decay * params[i];

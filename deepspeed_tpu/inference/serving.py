@@ -169,7 +169,24 @@ class ContinuousBatcher:
             return cache, token, pos, temp, top_p, rep, seen, done, first
 
         self._admit_fn = jax.jit(admit_fn)
-        self._set_done = jax.jit(lambda done, i: done.at[i, 0].set(True))
+
+        # retire: freeze the slot AND rewind its pos/cache_index to 0, so a
+        # frozen slot's continued (discarded) decode writes at position 0
+        # instead of marching past the cache length — correctness no longer
+        # leans on dynamic_update_slice index clamping.  ``i`` is traced
+        # (python int → weak scalar), so one executable serves every slot.
+        def retire_fn(done, pos, cache, i):
+            done = done.at[i, 0].set(True)
+            pos = pos.at[i].set(0)
+
+            def reset(path, leaf):
+                if getattr(path[-1], "key", None) == "cache_index":
+                    return leaf.at[i].set(0)
+                return leaf
+
+            return done, pos, jax.tree_util.tree_map_with_path(reset, cache)
+
+        self._retire_fn = jax.jit(retire_fn, donate_argnums=(2,))
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
@@ -255,7 +272,8 @@ class ContinuousBatcher:
         self._finished[act.req.uid] = np.concatenate(
             [act.req.prompt, np.asarray(act.emitted, np.int32)])
         self._slots[i] = None
-        self._done = self._set_done(self._done, i)
+        self._done, self._pos, self._cache = self._retire_fn(
+            self._done, self._pos, self._cache, i)
 
     # ------------------------------------------------------------------
     def step(self, ticks: int = 1) -> Dict[int, np.ndarray]:

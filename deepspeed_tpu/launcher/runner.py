@@ -64,6 +64,15 @@ def filter_hosts(hosts: dict[str, int], include: str = "", exclude: str = "") ->
     return hosts
 
 
+def _heartbeat_timeout(value: str) -> float:
+    t = float(value)
+    if 0 < t < 2.0:
+        raise argparse.ArgumentTypeError(
+            "must be >= 2s: workers throttle heartbeats to one write "
+            "per second (or 0 to disable)")
+    return t
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dstpu", description="DeepSpeed-TPU distributed launcher")
@@ -75,7 +84,8 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator_port", type=int, default=7777)
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
     p.add_argument("--ssh_port", type=int, default=22)
-    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+    p.add_argument("--heartbeat_timeout", type=_heartbeat_timeout,
+                   default=0.0,
                    help="seconds without a worker heartbeat before the job "
                         "is declared failed (0 = detector off)")
     p.add_argument("--max_restarts", type=int, default=0,
@@ -99,18 +109,32 @@ class HeartbeatMonitor:
         self.timeout = timeout
         self.grace = timeout * 3 if grace is None else grace
         self.t0 = time.monotonic()
+        # rank -> (last seen mtime, monotonic time we OBSERVED that mtime).
+        # Staleness is judged launcher-side on the monotonic clock, so an
+        # NTP step or worker/launcher mtime skew can't fake a dead worker.
+        self._seen: dict = {}
 
     def stale(self) -> list[int]:
         now = time.monotonic()
         bad = []
         for rank, path in enumerate(self.files):
             try:
-                age = time.time() - os.path.getmtime(path)
+                mtime = os.path.getmtime(path)
             except OSError:                      # not yet written
                 if now - self.t0 > self.grace:
                     bad.append(rank)
                 continue
-            if age > self.timeout:
+            prev = self._seen.get(rank)
+            if prev is None:
+                # first sighting counts as fresh: mtime is never used as a
+                # clock (only compared for equality), so NTP steps or
+                # launcher/worker mtime skew can't fake a dead worker.  A
+                # worker that beat once and died pre-launch costs one extra
+                # timeout to flag — the safe side of that trade.
+                self._seen[rank] = (mtime, now)
+            elif prev[0] != mtime:
+                self._seen[rank] = (mtime, now)  # fresh beat observed
+            if now - self._seen[rank][1] > self.timeout:
                 bad.append(rank)
         return bad
 
@@ -238,10 +262,6 @@ def main(argv=None) -> int:
         return _launch_hostfile(args)
     if args.num_processes > 1 or args.heartbeat_timeout > 0 \
             or args.max_restarts > 0:
-        if 0 < args.heartbeat_timeout < 2.0:
-            raise ValueError(
-                "--heartbeat_timeout must be >= 2s: workers throttle "
-                "heartbeats to one write per second")
         # restart loop: recovery = relaunch + load_checkpoint (the
         # reference's recovery model, automated; engine resumes from the
         # `latest` tag when the script calls load_checkpoint)

@@ -31,15 +31,20 @@ def build(force: bool = False) -> Optional[str]:
             os.path.getmtime(_LIB_PATH) >= max(os.path.getmtime(s) for s in srcs):
         return _LIB_PATH
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-march=native", "-ffast-math", "-fPIC", "-shared",
-           "-std=c++17", "-pthread", *srcs, "-o", _LIB_PATH]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
-        detail = getattr(e, "stderr", str(e))
-        logger.warning(f"native op build failed ({detail}); using numpy fallbacks")
-        return None
-    return _LIB_PATH
+    base = ["g++", "-O3", "-march=native", "-ffast-math", "-fPIC", "-shared",
+            "-std=c++17", "-pthread"]
+    # OpenMP multithreads the optimizer kernels (reference
+    # csrc/includes/cpu_adam.h:171); retry without it on toolchains that
+    # lack libgomp
+    for extra in (["-fopenmp"], []):
+        cmd = base + extra + [*srcs, "-o", _LIB_PATH]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            return _LIB_PATH
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            detail = getattr(e, "stderr", str(e))
+    logger.warning(f"native op build failed ({detail}); using numpy fallbacks")
+    return None
 
 
 @lru_cache(None)

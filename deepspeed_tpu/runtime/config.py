@@ -254,6 +254,11 @@ class Config:
     # so a ragged tail batch would recompile; set False only with padding.
     dataloader_drop_last: bool = True
     sparse_gradients: bool = False
+    # param-path regexes whose grads are row-sparse (untied embedding
+    # tables). Required non-empty when sparse_gradients is on: tied
+    # embeddings get DENSE grads (the LM head touches every row), so a
+    # name heuristic would silently corrupt them.
+    sparse_gradient_modules: list = dataclasses.field(default_factory=list)
 
     curriculum_learning: dict = dataclasses.field(default_factory=dict)
     progressive_layer_drop: dict = dataclasses.field(default_factory=dict)
@@ -356,6 +361,8 @@ class Config:
             communication_data_type=_take(d, C.COMMUNICATION_DATA_TYPE),
             dataloader_drop_last=bool(_take(d, C.DATALOADER_DROP_LAST, True)),
             sparse_gradients=bool(_take(d, C.SPARSE_GRADIENTS, False)),
+            sparse_gradient_modules=list(
+                _take(d, C.SPARSE_GRADIENT_MODULES, []) or []),
             curriculum_learning=dict(_take(d, C.CURRICULUM_LEARNING, {}) or {}),
             progressive_layer_drop=dict(_take(d, C.PROGRESSIVE_LAYER_DROP, {}) or {}),
             eigenvalue=dict(_take(d, C.EIGENVALUE, {}) or {}),
@@ -379,6 +386,7 @@ class Config:
             C.ACTIVATION_CHECKPOINTING, C.TENSORBOARD, C.WANDB, C.CSV_MONITOR,
             C.MESH, C.WALL_CLOCK_BREAKDOWN, C.MEMORY_BREAKDOWN,
             C.COMMUNICATION_DATA_TYPE, C.DATALOADER_DROP_LAST, C.SPARSE_GRADIENTS,
+            C.SPARSE_GRADIENT_MODULES,
             C.CURRICULUM_LEARNING, C.PROGRESSIVE_LAYER_DROP, C.EIGENVALUE,
             C.QUANTIZE_TRAINING, C.FLOPS_PROFILER, C.ELASTICITY, C.AUTOTUNING,
             C.SPARSE_ATTENTION, "model_overrides", "autotuned",

@@ -42,6 +42,7 @@ WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 MEMORY_BREAKDOWN = "memory_breakdown"
 
 SPARSE_GRADIENTS = "sparse_gradients"
+SPARSE_GRADIENT_MODULES = "sparse_gradient_modules"
 SPARSE_ATTENTION = "sparse_attention"
 
 DATALOADER_DROP_LAST = "dataloader_drop_last"

@@ -208,14 +208,16 @@ class UniversalSDLoader:
         self.ckpt_grid = [list(row) for row in ckpt_grid]
         self.axes_tree = axes_tree
         self.rules = rules
-        self._full_cache: Optional[tuple] = None   # (id(axes_tree), tree)
+        self._full_cache: Optional[tuple] = None   # (axes_tree ref, tree)
 
     def _full_tree(self, axes_tree: dict) -> dict:
         # merge once, serve every target rank from it — a (pp×tp) restore
         # calls load() pp*tp times and must not re-read the whole
-        # checkpoint each time
+        # checkpoint each time.  Keyed on the axes_tree object itself (a
+        # held strong reference compared with ``is``) — an id() key can
+        # alias a new dict after the old one is collected.
         if self._full_cache is not None and \
-                self._full_cache[0] == id(axes_tree):
+                self._full_cache[0] is axes_tree:
             return self._full_cache[1]
         stages = []
         for row in self.ckpt_grid:
@@ -224,7 +226,7 @@ class UniversalSDLoader:
                           if len(shards) > 1 else shards[0])
         full = merge_pp_stage_trees(stages, axes_tree) \
             if len(stages) > 1 else stages[0]
-        self._full_cache = (id(axes_tree), full)
+        self._full_cache = (axes_tree, full)
         return full
 
     def load(self, tp_size: int, tp_rank: int, pp_size: int = 1,

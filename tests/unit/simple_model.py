@@ -27,6 +27,27 @@ class SimpleModel(nn.Module):
                 "y": jnp.zeros((batch_size, self.hidden_dim))}
 
 
+class EmbedModel(nn.Module):
+    """Untied-embedding LM head — the shape of model sparse_gradients
+    targets (reference sparse grads come from nn.Embedding(sparse=True))."""
+
+    vocab: int = 64
+    dim: int = 16
+
+    @nn.compact
+    def __call__(self, input_ids, labels, deterministic: bool = True):
+        h = nn.Embed(self.vocab, self.dim, name="tok_embed")(input_ids)
+        h = nn.relu(nn.Dense(self.dim, name="proj")(h))
+        logits = nn.Dense(self.vocab, name="head")(h)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        return {"loss": jnp.mean(nll), "logits": logits}
+
+    def dummy_inputs(self, batch_size=2, seq_len=8):
+        ids = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return {"input_ids": ids, "labels": ids}
+
+
 def random_dataset(total_samples: int, hidden_dim: int, seed: int = 0):
     rng = np.random.default_rng(seed)
     xs = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)

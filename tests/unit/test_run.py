@@ -65,11 +65,12 @@ def test_heartbeat_monitor_stale_detection(tmp_path):
     mon = HeartbeatMonitor([f0, f1], timeout=0.2, grace=0.5)
     assert mon.stale() == []          # inside startup grace
     (tmp_path / "hb_0").write_text("x")
+    assert mon.stale() == []          # first sighting counts as fresh
     time.sleep(0.6)
-    # rank 0 beat once but went stale; rank 1 never appeared past grace
+    # rank 0 went silent past timeout; rank 1 never appeared past grace
     assert mon.stale() == [0, 1]
     (tmp_path / "hb_0").write_text("x")
-    assert mon.stale() == [1]
+    assert mon.stale() == [1]         # fresh beat observed monotonically
 
 
 def test_heartbeat_beat_env(tmp_path, monkeypatch):
@@ -120,9 +121,11 @@ def test_launcher_rejects_sub_throttle_timeout(tmp_path):
 
     script = tmp_path / "noop.py"
     script.write_text("pass\n")
-    with _pytest.raises(ValueError):
+    # argparse type validation → clean usage error (exit 2), not traceback
+    with _pytest.raises(SystemExit) as ei:
         main(["--num_processes", "1", "--heartbeat_timeout", "0.5",
               str(script)])
+    assert ei.value.code == 2
 
 
 # ---------------- auxiliary CLI tools (ds_ssh / ds_elastic analogs) ----------
