@@ -108,6 +108,24 @@ class InferenceEngine:
         if self.config.dtype is not None:
             cfg = dataclasses.replace(cfg, dtype=self.config.dtype)
         self.model_cfg = dataclasses.replace(cfg, remat=False)
+        # real int8 weight-only serving (ops/w8.py; reference
+        # pt_binding.cpp:622 int8 GEMMs): int8 storage + dequant-fused
+        # matmul.  Families without a w8 config field (or quant.fake=true,
+        # or bits != 8) keep the grouped fake-quant load path below.
+        self._w8 = False
+        q = self.config.quant
+        if q.get("enabled") and hasattr(cfg, "w8"):
+            bits = int(q.get("bits", q.get("qtype", 8)))
+            if bits == 8 and not q.get("fake", False):
+                self._w8 = True
+                self.model_cfg = dataclasses.replace(
+                    self.model_cfg, w8=True,
+                    w8_group=int(q.get("group_size", 128)))
+                if getattr(cfg, "moe", None) is not None:
+                    logger.warning(
+                        "int8 serving quantizes dense *_kernel weights "
+                        "only; MoE expert weights (wi/wo/wg) stay full "
+                        "width this round")
         # models name their context-length field differently
         pos_field = "n_positions" if hasattr(cfg, "n_positions") \
             else "max_position_embeddings"
@@ -179,7 +197,14 @@ class InferenceEngine:
         unboxed = jax.tree_util.tree_map(
             lambda x: getattr(x, "value", x), params,
             is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
-        if self.config.quant.get("enabled"):
+        if self._w8:
+            from ..ops.w8 import quantize_dense_tree
+
+            unboxed = quantize_dense_tree(
+                unboxed, group=self.model_cfg.w8_group)
+            log_dist("quantized dense kernels to int8 codes + grouped "
+                     "scales (W8A16 serving)", ranks=[0])
+        elif self.config.quant.get("enabled"):
             # inference weight quantization (the WeightQuantization / MoQ
             # checkpoint-quantize analog, reference weight_quantizer.py):
             # grouped fake-quant of >=2-D weights at load

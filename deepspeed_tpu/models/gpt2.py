@@ -60,6 +60,15 @@ class GPT2Config:
     # the MLP).  With scan_layers the stack is homogeneous, so MoE applies
     # to EVERY block (use use_residual=True for the PR-MoE dense+MoE mix).
     moe: Optional[Any] = None          # parallel.moe.MoEConfig
+    # weight-only int8 serving (ops/w8.py): dense kernels stored as int8
+    # codes + grouped fp32 scales, consumed by a dequant-fused matmul
+    # (reference pt_binding.cpp:622 int8 GEMMs).  Set by init_inference.
+    w8: bool = False
+    w8_group: int = 128
+    # chunked tied-head loss (common.chunked_lm_loss): token rows per
+    # chunk; None = dense logits.  Saves the (B,S,V) fp32 logits+cotangent
+    # at large micro sizes; the model output then carries no "logits".
+    loss_chunk: Optional[int] = None
 
     @property
     def padded_vocab_size(self) -> int:
@@ -111,10 +120,32 @@ def _dense_params(in_features, features, names, *, cfg: GPT2Config, name: str,
 def _dense(x, features, names, *, cfg: GPT2Config, name: str, module: nn.Module,
            init_std: Optional[float] = None, use_bias: bool = True):
     """Annotated dense layer: kernel gets logical axis names ``names``."""
-    kernel, bias = _dense_params(x.shape[-1], features, names, cfg=cfg,
-                                 name=name, module=module, init_std=init_std,
-                                 use_bias=use_bias)
-    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if cfg.w8:
+        # int8 codes + grouped scales declared IN PLACE of the fp kernel
+        # (ops/w8.py W8A16 path); names line up with what
+        # quantize_dense_tree emits from a trained checkpoint
+        from ..ops.w8 import w8a16_matmul
+
+        K = x.shape[-1]
+        g = cfg.w8_group if K % cfg.w8_group == 0 else K
+        codes = module.param(
+            name + "_kernel_q",
+            nn.with_partitioning(nn.initializers.zeros, names),
+            (K, features), jnp.int8)
+        scale = module.param(
+            name + "_kernel_s",
+            nn.with_partitioning(nn.initializers.ones, (None, names[-1])),
+            (K // g, features), jnp.float32)
+        y = w8a16_matmul(x, codes, scale)
+        bias = module.param(
+            name + "_bias",
+            nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+            (features,), cfg.param_dtype) if use_bias else None
+    else:
+        kernel, bias = _dense_params(
+            x.shape[-1], features, names, cfg=cfg, name=name, module=module,
+            init_std=init_std, use_bias=use_bias)
+        y = jnp.dot(x, kernel.astype(cfg.dtype))
     if bias is not None:
         y = y + bias.astype(cfg.dtype)
     return y
@@ -237,7 +268,8 @@ class MLP(nn.Module):
 
     def _use_fused(self) -> bool:
         cfg = self.cfg
-        if not cfg.fused_mlp or cfg.resid_pdrop > 0.0 or not on_tpu():
+        if not cfg.fused_mlp or cfg.resid_pdrop > 0.0 or cfg.w8 \
+                or not on_tpu():
             return False
         from ..ops.pallas.fused_mlp import fits_vmem
 
@@ -354,6 +386,20 @@ class GPT2LMHeadModel(nn.Module):
                 aux_loss = aux_loss + aux
 
         h = LayerNorm(cfg, name="ln_f")(h)
+        if cfg.loss_chunk and labels is not None:
+            # memory-bounded head: logits never fully materialize
+            from .common import chunked_lm_loss
+
+            tgt = shift_labels(labels) if shift else labels
+            loss = chunked_lm_loss(
+                h, wte, tgt, vocab_size=cfg.vocab_size,
+                padded_vocab_size=cfg.padded_vocab_size,
+                chunk=cfg.loss_chunk, dtype=cfg.dtype)
+            out = ModelOutput(loss=loss)
+            if cfg.moe is not None:
+                out["aux_loss"] = aux_loss
+                out["loss"] = loss + aux_loss
+            return out
         logits = jnp.dot(h, wte.astype(cfg.dtype).T)
         if cfg.padded_vocab_size != cfg.vocab_size:
             # mask padded vocab columns out of the softmax

@@ -1,0 +1,131 @@
+"""8-bit (quantized-state) Adam(W): Adam moments stored as int8/uint8.
+
+The memory lever that makes billion-parameter Adam fit a single chip's
+HBM: fp32 m+v cost 8 bytes/param — quantized rows cost 2 bytes/param
+(+ ~1/row fp32 scale).  For GPT-2-1.5B that is 12.5 GB → 3.1 GB, the
+difference between fitting and not fitting a 16 GB chip alongside the
+fp32 master (the regime the reference reaches by sharding optimizer
+state across 8 GPUs — ``/root/reference/docs/_tutorials/zero.md:29`` —
+or by CPU offload, ``csrc/adam/cpu_adam.cpp``).  Same compressed-state
+family as the 1-bit optimizers (reference ``runtime/fp16/onebit/``),
+but lossy-compressing *storage* instead of *communication*.
+
+Design (TPU-first):
+- Row-wise (last-axis) absmax scaling.  Transformer leaves have rows of
+  1.6k–6.4k elements — the same granularity class as the published
+  block-2048 dynamic quantization this follows (PAPERS.md: 8-bit
+  optimizers via block-wise quantization), without padding/reshape, and
+  the codes keep the PARAM's shape, so ZeRO sharding specs apply to the
+  quantized state unchanged (``parallel/zero.py:opt_state_specs``).
+- ``m`` (signed) → int8 symmetric; ``sqrt(v)`` (non-negative) → uint8.
+  Storing the root halves v's dynamic range in log space and is what the
+  denominator consumes anyway.
+- De/re-quantization happens inside the one compiled update — XLA fuses
+  it into the elementwise optimizer math; int8 HBM reads are the point.
+- The scale trees are nested one level deeper than params (``{"m","r"}``
+  dicts) ON PURPOSE: ``opt_state_specs`` structure-matches param-shaped
+  subtrees for sharding, and a (…, 1) scale must fall through to
+  replicated, not inherit a row-sharded spec.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+ScalarOrSchedule = Union[float, Callable]
+
+
+def _quant_sym(x: jax.Array):
+    """fp32 → (int8 codes, fp32 row scale), symmetric absmax per last axis."""
+    if x.ndim == 0:
+        amax = jnp.abs(x)
+    else:
+        amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def _quant_pos(x: jax.Array):
+    """non-negative fp32 → (uint8 codes, fp32 row scale)."""
+    if x.ndim == 0:
+        amax = x
+    else:
+        amax = jnp.max(x, axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 255.0, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(x / scale), 0, 255).astype(jnp.uint8)
+    return codes, scale
+
+
+class Adam8bitState(NamedTuple):
+    count: jax.Array
+    m_codes: Any        # int8, param-shaped (shards like params)
+    r_codes: Any        # uint8, param-shaped; r = sqrt(v)
+    scales: Any         # {"m": (...,1), "r": (...,1)} per leaf — replicated
+
+
+def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
+                      eps: float = 1e-8) -> optax.GradientTransformation:
+    def init_fn(params):
+        m_codes = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.int8), params)
+        r_codes = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.uint8), params)
+
+        def scale0(p):
+            shp = p.shape[:-1] + (1,) if p.ndim else ()
+            return {"m": jnp.ones(shp, jnp.float32),
+                    "r": jnp.ones(shp, jnp.float32)}
+
+        return Adam8bitState(count=jnp.zeros([], jnp.int32),
+                             m_codes=m_codes, r_codes=r_codes,
+                             scales=jax.tree_util.tree_map(scale0, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_int32_increment(state.count)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def leaf(g, mc, rc, sc):
+            g = g.astype(jnp.float32)
+            m = mc.astype(jnp.float32) * sc["m"]
+            r = rc.astype(jnp.float32) * sc["r"]
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * (r * r) + (1.0 - b2) * (g * g)
+            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            mc, ms = _quant_sym(m)
+            rc, rs = _quant_pos(jnp.sqrt(v))
+            return upd, mc, rc, {"m": ms, "r": rs}
+
+        # scales sit one level deeper than params; tree_map's
+        # flatten_up_to treats each {"m","r"} dict as the leaf for its path
+        out = jax.tree_util.tree_map(leaf, updates, state.m_codes,
+                                     state.r_codes, state.scales)
+        upd, m_codes, r_codes, scales_t = jax.tree_util.tree_transpose(
+            jax.tree_util.tree_structure(updates),
+            jax.tree_util.tree_structure((0, 0, 0, {"m": 0, "r": 0})),
+            out)
+        # transpose inverts nesting ({"m": param-tree, ...}); restore the
+        # param-tree-of-{"m","r"} layout init_fn established
+        scales = jax.tree_util.tree_map(
+            lambda m, r: {"m": m, "r": r}, scales_t["m"], scales_t["r"])
+        return upd, Adam8bitState(count=count, m_codes=m_codes,
+                                  r_codes=r_codes, scales=scales)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw_8bit(learning_rate: ScalarOrSchedule, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8,
+               weight_decay: float = 0.0,
+               mask: Optional[Any] = None) -> optax.GradientTransformation:
+    """AdamW with int8 moments (drop-in for ``optax.adamw``)."""
+    parts = [scale_by_adam8bit(b1=b1, b2=b2, eps=eps)]
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay, mask=mask))
+    parts.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*parts)

@@ -1,0 +1,84 @@
+"""Weight-only int8 (W8A16) serving: int8 storage + dequant-fused matmul.
+
+Real int8 — not fake-quant: weights live in HBM as int8 codes plus
+per-(group, out-channel) fp32 scales (half the bytes of bf16, quarter of
+fp32), and the matmul consumes the codes directly; dequantization happens
+on-chip inside the fused contraction, never materializing a full-width
+weight tensor.  Decode is HBM-bandwidth-bound, so halving weight bytes is
+a direct decode-throughput lever.  The analog of the reference's int8
+inference GEMMs + dequant kernels
+(``/root/reference/csrc/transformer/inference/csrc/pt_binding.cpp:622,709,770``
+``ds_qkv_gemm_int8`` / ``ds_vector_matmul_int8`` and ``dequantize.cu``),
+with the groupwise-scale scheme of its ``quantizer.cu``.
+
+Layout: a (K, N) kernel quantizes along the contraction axis K in groups
+of ``group`` rows — codes int8 (K, N), scales fp32 (K/group, N).  The
+grouped einsum keeps int8 operands until the MXU upcast, so XLA reads
+int8 from HBM and fuses the per-group scale into the output combine.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_weight(w: jax.Array, group: int = 128):
+    """(K, N) float → (int8 codes (K, N), fp32 scales (K/group, N)).
+
+    Symmetric absmax per (group, out-channel); ``group`` falls back to K
+    when it does not divide K.  A 3-D input is a scanned layer stack
+    (L, K, N) and quantizes per layer."""
+    if w.ndim == 3:
+        codes, scale = jax.vmap(lambda l: quantize_weight(l, group))(
+            jnp.asarray(w))
+        return codes, scale
+    K, N = w.shape
+    g = group if K % group == 0 else K
+    wf = jnp.asarray(w, jnp.float32).reshape(K // g, g, N)
+    amax = jnp.max(jnp.abs(wf), axis=1, keepdims=True)        # (G, 1, N)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    codes = jnp.clip(jnp.round(wf / scale), -127, 127)
+    return (codes.reshape(K, N).astype(jnp.int8),
+            scale[:, 0, :].astype(jnp.float32))
+
+
+def w8a16_matmul(x: jax.Array, codes: jax.Array, scale: jax.Array):
+    """``x @ dequant(codes, scale)`` without materializing the weight.
+
+    x: (..., K) activation (bf16/fp32); codes: int8 (K, N); scale: fp32
+    (G, N) with G | K.  Per-group partial products accumulate in fp32 and
+    the scale folds into the combine."""
+    K, N = codes.shape
+    G = scale.shape[0]
+    g = K // G
+    cdt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.bfloat16
+    xg = x.reshape(*x.shape[:-1], G, g)
+    cg = codes.reshape(G, g, N)
+    # group dot in the activation dtype (TPU MXU accumulates fp32
+    # internally; CPU lacks mixed bf16→f32 dots), scale combine in fp32
+    part = jnp.einsum("...ug,ugn->...un", xg.astype(cdt), cg.astype(cdt))
+    y = jnp.einsum("...un,un->...n", part.astype(jnp.float32), scale)
+    return y.astype(x.dtype)
+
+
+def quantize_dense_tree(params, group: int = 128, suffix: str = "_kernel"):
+    """Convert every 2-D ``*_kernel`` leaf of a host param tree to the
+    serving layout: ``name_q`` int8 codes + ``name_s`` fp32 scales.
+    Embeddings / norms / biases pass through at full width."""
+    def convert(subtree):
+        if not isinstance(subtree, dict):
+            return subtree
+        out = {}
+        for k, v in subtree.items():
+            if isinstance(v, dict):
+                out[k] = convert(v)
+            elif k.endswith(suffix) and np.ndim(v) in (2, 3):
+                codes, scale = quantize_weight(jnp.asarray(v), group)
+                out[k + "_q"] = np.asarray(codes)
+                out[k + "_s"] = np.asarray(scale)
+            else:
+                out[k] = v
+        return out
+
+    return convert(params)

@@ -92,6 +92,137 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
     return lax.psum(loss_sum, axis) / M
 
 
+def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
+                          *, embed_fn: Callable, stage_fn: Callable,
+                          loss_fn: Callable, axis: str = "pp"):
+    """EXECUTED 1F1B (reference ``runtime/pipe/schedule.py:182``
+    ``TrainSchedule``): loss AND grads from one compiled clock loop whose
+    live-activation footprint is bounded by the schedule depth O(S), not
+    by the microbatch count M.
+
+    GPipe-via-autodiff (:func:`gpipe_loss` under ``jax.grad``) must keep
+    every in-flight microbatch's stage input for the backward — O(M)
+    residuals.  Here the backward is explicit: each stage keeps a rotating
+    buffer of ``D = 2S-1`` stage inputs, recomputes its sub-stack forward
+    at backward time (per-stage remat), and applies ``jax.vjp`` per
+    microbatch, so the scan carry — and therefore peak memory — is
+    independent of M.
+
+    Clock math (uniform across stages, masking selects validity): stage
+    ``s`` forwards microbatch ``f = t - s`` and backwards microbatch
+    ``k = t - (2S-2-s)`` at tick ``t``; the last stage seeds the cotangent
+    from the loss of the microbatch it forwarded the same tick, and
+    cotangents ride the ring upward one hop per tick.  Total ticks
+    ``T = M + 2S - 2``.  An in-flight residual lives ``2(S-1-s)+1 ≤ D``
+    ticks, so slots never collide.
+
+    ``scale``: loss-scale seed for the backward (fp16 path); the returned
+    loss is the scaled sum / M, matching the gpipe path's contract.
+
+    Returns ``(loss, shared_grads, stage_grads)`` — shared grads psum'd
+    over the ring (tied-weight sync of reference ``pipe/module.py:419``),
+    stage grads local to each stage.
+    """
+    S = lax.axis_size(axis)
+    sid = lax.axis_index(axis)
+    leaves = jax.tree_util.tree_leaves(microbatches)
+    M = leaves[0].shape[0]
+    T = M + 2 * S - 2
+    D = 2 * S - 1
+
+    def pick_mb(t):
+        idx = jnp.clip(t, 0, M - 1)
+        return jax.tree_util.tree_map(
+            lambda x: lax.dynamic_index_in_dim(x, idx, 0, keepdims=False),
+            microbatches)
+
+    mb0 = pick_mb(jnp.int32(0))
+    h_sds = jax.eval_shape(lambda: embed_fn(shared_params, mb0))
+    x0 = _pvary(jnp.zeros(h_sds.shape, h_sds.dtype), axis)
+    ct0 = _pvary(jnp.zeros(h_sds.shape, h_sds.dtype), axis)
+    resid0 = _pvary(jnp.zeros((D,) + h_sds.shape, h_sds.dtype), axis)
+    f32 = jnp.float32
+    g_sh0 = _pvary(jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, f32), shared_params), axis)
+    g_st0 = _pvary(jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, f32), stage_params), axis)
+    loss0 = _pvary(jnp.zeros((), f32), axis)
+
+    def tick(carry, t):
+        fwd_in, ct_in, resid, g_sh, g_st, loss_acc = carry
+
+        # ---- forward: microbatch f = t - sid ----
+        f = t - sid
+        do_fwd = jnp.logical_and(f >= 0, f < M)
+        mb_f = pick_mb(f)
+        x = jnp.where(sid == 0, embed_fn(shared_params, mb_f), fwd_in)
+        y = stage_fn(stage_params, x)
+        slot_f = jnp.mod(jnp.maximum(f, 0), D)
+        resid = jnp.where(
+            do_fwd, lax.dynamic_update_index_in_dim(resid, x, slot_f, 0),
+            resid)
+
+        # ---- backward: microbatch k = t - (2S-2-sid) ----
+        k = t - (2 * S - 2 - sid)
+        do_bwd = jnp.logical_and(k >= 0, k < M)
+        mb_k = pick_mb(k)
+        x_k = lax.dynamic_index_in_dim(
+            resid, jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
+        y_k, stage_vjp = jax.vjp(stage_fn, stage_params, x_k)
+        loss_k, head_vjp = jax.vjp(
+            lambda sh, h: loss_fn(sh, h, mb_k), shared_params, y_k)
+        # seed scale/M: grads must match d(scale · mean-over-M loss)
+        g_head_sh, ct_loss = head_vjp((scale / M).astype(loss_k.dtype))
+        is_last = sid == S - 1
+        ct_y = jnp.where(is_last, ct_loss, ct_in)
+        g_st_k, ct_x = stage_vjp(ct_y)
+        g_emb_sh = jax.vjp(
+            lambda sh: embed_fn(sh, mb_k), shared_params)[1](ct_x)[0]
+
+        m_bwd = do_bwd.astype(f32)
+        m_head = m_bwd * is_last.astype(f32)
+        m_emb = m_bwd * (sid == 0).astype(f32)
+        g_st = jax.tree_util.tree_map(
+            lambda a, b: a + m_bwd * b.astype(f32), g_st, g_st_k)
+        g_sh = jax.tree_util.tree_map(
+            lambda a, bh, be: a + m_head * bh.astype(f32)
+            + m_emb * be.astype(f32), g_sh, g_head_sh, g_emb_sh)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, do_bwd),
+            loss_k.astype(f32) * scale, 0.0)
+
+        # ---- ring: activations down, cotangents up ----
+        fwd_next = lax.ppermute(y, axis, [(i, (i + 1) % S) for i in range(S)])
+        ct_next = lax.ppermute(ct_x, axis, [(i, (i - 1) % S) for i in range(S)])
+        return (fwd_next, ct_next, resid, g_sh, g_st, loss_acc), None
+
+    carry0 = (x0, ct0, resid0, g_sh0, g_st0, loss0)
+    (_, _, _, g_sh, g_st, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
+    loss = lax.psum(loss_sum, axis) / M
+    g_sh = lax.psum(g_sh, axis)
+    return loss, g_sh, g_st
+
+
+def onef1b_spmd_grads(mesh, shared_params, stage_params, microbatches, scale,
+                      *, embed_fn, stage_fn, loss_fn,
+                      stage_params_layer_dim_spec, axis: str = "pp"):
+    """shard_map wrapper for :func:`onef1b_loss_and_grads` — manual only
+    over ``pp`` like :func:`pipeline_spmd_loss`, so ZeRO/TP/DP sharding
+    inside each stage stays automatic."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = functools.partial(onef1b_loss_and_grads, embed_fn=embed_fn,
+                           stage_fn=stage_fn, loss_fn=loss_fn, axis=axis)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(), stage_params_layer_dim_spec, P(), P()),
+        out_specs=(P(), P(), stage_params_layer_dim_spec),
+        check_vma=False,
+        axis_names={axis},
+    )(shared_params, stage_params, microbatches, scale)
+
+
 def pipeline_spmd_loss(mesh, shared_params, stage_params, microbatches, *,
                        embed_fn, stage_fn, loss_fn,
                        stage_params_layer_dim_spec, axis: str = "pp"):

@@ -153,13 +153,39 @@ def opt_state_specs(optimizer, abstract_params, param_like_specs):
         lambda x: getattr(x, "value", x), abstract_params,
         is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
     abstract_opt = jax.eval_shape(optimizer.init, unboxed)
-    return optax.tree_map_params(
-        optimizer,
-        lambda _, spec: spec,
-        abstract_opt,
-        param_like_specs,
-        transform_non_params=lambda _: P(),
-    )
+    try:
+        return optax.tree_map_params(
+            optimizer,
+            lambda _, spec: spec,
+            abstract_opt,
+            param_like_specs,
+            transform_non_params=lambda _: P(),
+        )
+    except (ValueError, TypeError, AttributeError):
+        # custom transforms (ops/adam8bit.py) keep param-SHAPED state the
+        # placeholder protocol can't see; shard any state leaf that shares
+        # a param's shape like that param, replicate the rest (count,
+        # per-row scales).  Scoped to states that actually carry the
+        # custom transform — a mapping failure for a standard optimizer is
+        # a real bug and must surface.
+        from ..ops.adam8bit import Adam8bitState
+
+        def subtrees(t):
+            yield t
+            if isinstance(t, (tuple, list)):
+                for c in t:
+                    yield from subtrees(c)
+
+        if not any(isinstance(t, Adam8bitState)
+                   for t in subtrees(abstract_opt)):
+            raise
+        shape_to_spec = {}
+        spec_leaves = jax.tree_util.tree_leaves(
+            param_like_specs, is_leaf=lambda x: isinstance(x, P))
+        for pl, sl in zip(jax.tree_util.tree_leaves(unboxed), spec_leaves):
+            shape_to_spec.setdefault(pl.shape, sl)
+        return jax.tree_util.tree_map(
+            lambda l: shape_to_spec.get(l.shape, P()), abstract_opt)
 
 
 def named_shardings(mesh, spec_tree):
@@ -288,15 +314,19 @@ class GatheredParameters:
         # nothing back.
         self.enabled = enabled
         self.result = None
-        # modifier_rank parity note: every host runs the same SPMD program,
-        # so "rank 0 modifies, then broadcast" is the only supported mode —
-        # identical mutation on every host IS the broadcast.
+        # reference modifier_rank semantics (partition_parameters.py:1502):
+        # only the modifier rank's writes persist — __exit__ broadcasts its
+        # host tree, so other processes' mutations are discarded.
+        self.modifier_rank = modifier_rank
 
     def __enter__(self):
         self._orig = self._source_tree()
         if not self.enabled:
             self.result = self._orig
             return self._orig
+        # leaf-at-a-time gather: only ONE leaf is ever fully replicated on
+        # device before its host copy lands and the replica is dropped, so
+        # peak device memory is bounded by the largest leaf, not the model
         self._host = jax.tree_util.tree_map(_gather_to_host, self._orig)
         return self._host
 
@@ -308,6 +338,16 @@ class GatheredParameters:
     def __exit__(self, exc_type, exc, tb):
         if exc_type is not None or not self.enabled:
             return False
+        if jax.process_count() > 1 and self.modifier_rank is not None:
+            # only the modifier rank's edits survive (reference
+            # modifier_rank contract) — host-plane broadcast keeps every
+            # process's re-sharded tree identical.  modifier_rank=None is
+            # the reference's "all ranks modified identically" mode: no
+            # broadcast.
+            from .. import comm as _comm
+
+            self._host = _comm.host_broadcast(self._host,
+                                              src=self.modifier_rank)
         resharded = jax.tree_util.tree_map(
             lambda h, o: jax.device_put(
                 jnp_asarray(h, getattr(o, "dtype", None)),
@@ -333,10 +373,14 @@ def _gather_to_host(x) -> np.ndarray:
 
     ``np.array`` on an array spanning non-addressable devices raises, so
     replicate on-device first (a collective every process participates in)
-    — then every host holds all the data."""
+    — then copy to host and DROP the device replica immediately, so a
+    tree-wide gather holds at most one replicated leaf on device."""
     if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding) \
             and not x.is_fully_replicated:
-        x = jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
+        repl = jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
+        host = np.array(repl)
+        repl.delete()
+        return host
     return np.array(x)
 
 

@@ -260,6 +260,10 @@ class Config:
     # name heuristic would silently corrupt them.
     sparse_gradient_modules: list = dataclasses.field(default_factory=list)
 
+    # pipeline-engine knobs: {"schedule": "gpipe" | "1f1b"} — 1f1b runs
+    # the explicit-vjp clock loop whose activation memory is O(stages),
+    # not O(microbatches) (parallel/pipeline.py onef1b_loss_and_grads)
+    pipeline: dict = dataclasses.field(default_factory=dict)
     curriculum_learning: dict = dataclasses.field(default_factory=dict)
     progressive_layer_drop: dict = dataclasses.field(default_factory=dict)
     eigenvalue: dict = dataclasses.field(default_factory=dict)
@@ -363,6 +367,7 @@ class Config:
             sparse_gradients=bool(_take(d, C.SPARSE_GRADIENTS, False)),
             sparse_gradient_modules=list(
                 _take(d, C.SPARSE_GRADIENT_MODULES, []) or []),
+            pipeline=dict(_take(d, C.PIPELINE, {}) or {}),
             curriculum_learning=dict(_take(d, C.CURRICULUM_LEARNING, {}) or {}),
             progressive_layer_drop=dict(_take(d, C.PROGRESSIVE_LAYER_DROP, {}) or {}),
             eigenvalue=dict(_take(d, C.EIGENVALUE, {}) or {}),
@@ -386,7 +391,7 @@ class Config:
             C.ACTIVATION_CHECKPOINTING, C.TENSORBOARD, C.WANDB, C.CSV_MONITOR,
             C.MESH, C.WALL_CLOCK_BREAKDOWN, C.MEMORY_BREAKDOWN,
             C.COMMUNICATION_DATA_TYPE, C.DATALOADER_DROP_LAST, C.SPARSE_GRADIENTS,
-            C.SPARSE_GRADIENT_MODULES,
+            C.SPARSE_GRADIENT_MODULES, C.PIPELINE,
             C.CURRICULUM_LEARNING, C.PROGRESSIVE_LAYER_DROP, C.EIGENVALUE,
             C.QUANTIZE_TRAINING, C.FLOPS_PROFILER, C.ELASTICITY, C.AUTOTUNING,
             C.SPARSE_ATTENTION, "model_overrides", "autotuned",

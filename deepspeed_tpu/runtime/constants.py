@@ -43,6 +43,7 @@ MEMORY_BREAKDOWN = "memory_breakdown"
 
 SPARSE_GRADIENTS = "sparse_gradients"
 SPARSE_GRADIENT_MODULES = "sparse_gradient_modules"
+PIPELINE = "pipeline"
 SPARSE_ATTENTION = "sparse_attention"
 
 DATALOADER_DROP_LAST = "dataloader_drop_last"
@@ -68,11 +69,14 @@ LAMB_OPTIMIZER = "lamb"
 SGD_OPTIMIZER = "sgd"
 ADAGRAD_OPTIMIZER = "adagrad"
 LION_OPTIMIZER = "lion"
+ADAM8BIT_OPTIMIZER = "adam8bit"
+ADAMW8BIT_OPTIMIZER = "adamw8bit"
 ONEBIT_ADAM_OPTIMIZER = "onebitadam"
 ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
 ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
 DEEPSPEED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, SGD_OPTIMIZER,
-    ADAGRAD_OPTIMIZER, LION_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+    ADAGRAD_OPTIMIZER, LION_OPTIMIZER, ADAM8BIT_OPTIMIZER,
+    ADAMW8BIT_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
     ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER,
 ]

@@ -128,6 +128,36 @@ class Engine:
         self.offload_device = self.config.zero.offload_optimizer.device
         if self.offload_device not in ("none", "cpu", "nvme"):
             raise ValueError(f"offload_optimizer.device {self.offload_device!r}")
+        # ZeRO-3 parameter offload (runtime/param_offload.py; reference
+        # partitioned_param_swapper.py:37): host/NVMe master, layer-group
+        # streaming.  Subsumes optimizer offload (CPU-Adam runs on host).
+        self.param_offload_device = self.config.zero.offload_param.device
+        self._param_offload = None
+        if self.param_offload_device != "none":
+            if self.param_offload_device not in ("cpu", "nvme"):
+                raise ValueError(
+                    f"offload_param.device {self.param_offload_device!r}")
+            if self.zero_stage != 3:
+                raise ValueError("offload_param requires zero stage 3 "
+                                 "(reference constraint)")
+            if self.config.fp16.enabled:
+                raise NotImplementedError("fp16 + param offload: use bf16")
+            if self.config.gradient_accumulation_steps > 1:
+                raise NotImplementedError(
+                    "param offload streams one global batch per step; set "
+                    "gradient_accumulation_steps=1 (raise the micro size)")
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "param offload is single-process: each host would step "
+                    "its own master without a grad allreduce")
+            if self.config.progressive_layer_drop.get("enabled"):
+                raise NotImplementedError(
+                    "progressive_layer_drop does not thread through the "
+                    "param-offload stage loop; disable one of them")
+            if self.n_devices > 1:
+                logger.warning(
+                    "param offload streams through ONE device; the other "
+                    f"{self.n_devices - 1} mesh devices stay idle")
         if self.offload_device != "none" and self.config.fp16.enabled:
             raise NotImplementedError("fp16 + optimizer offload: use bf16")
         if self.offload_device != "none":
@@ -327,6 +357,19 @@ class Engine:
                     lambda s: jnp.zeros(s.shape, s.dtype), example_sds)
                 return self.model.init(r, **fake)
             boxed = jax.eval_shape(_init, rng)["params"]
+
+        if self.param_offload_device != "none":
+            # host-resident master: never materialize the tree on device
+            # (runtime/param_offload.py; zero.Init(remote_device) analog)
+            from .param_offload import ParamOffloadRunner, host_init_tree
+
+            self._param_offload = ParamOffloadRunner(
+                self.model, self.config, self.lr_scheduler)
+            host = params if params is not None else host_init_tree(
+                _unbox(boxed), seed=self.config.seed,
+                std=getattr(self.model.cfg, "initializer_range", 0.02))
+            self._param_offload.init_host(host)
+            return
 
         self._build_specs(boxed)
         param_sh = zero_lib.named_shardings(self.mesh, self._param_specs)
@@ -716,26 +759,40 @@ class Engine:
         """Train step when mesh pp>1: grad-accumulation micro-batches ARE
         the pipeline micro-batches; the whole GPipe wave is one scan (see
         ``parallel/pipeline.py``)."""
-        from ..parallel.pipeline import pipeline_spmd_loss
+        from ..parallel.pipeline import onef1b_spmd_grads, pipeline_spmd_loss
 
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
-        embed_fn, stage_fn, loss_fn, split_params, _ = \
+        schedule = cfg.pipeline.get("schedule", "gpipe")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline.schedule must be gpipe|1f1b, "
+                             f"got {schedule!r}")
+        embed_fn, stage_fn, loss_fn, split_params, merge_params = \
             self.model.pipeline_fns(self.pp_size)
 
         def step_fn(state: TrainState, batch):
             scale = state.loss_scale.scale if cfg.fp16.enabled else jnp.float32(1.0)
             mbs = self._split_microbatches(batch, gas)
 
-            def scaled_loss(params):
-                shared, stage_params = split_params(params)
-                loss = pipeline_spmd_loss(
-                    self.mesh, shared, stage_params, mbs,
+            if schedule == "1f1b":
+                # explicit-vjp clock loop: O(stages) live activations
+                # (reference TrainSchedule, runtime/pipe/schedule.py:182)
+                shared, stage_params = split_params(state.params)
+                loss, g_sh, g_st = onef1b_spmd_grads(
+                    self.mesh, shared, stage_params, mbs, scale,
                     embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
                     stage_params_layer_dim_spec=P("pp"))
-                return loss * scale
+                grads = merge_params(g_sh, g_st)
+            else:
+                def scaled_loss(params):
+                    shared, stage_params = split_params(params)
+                    loss = pipeline_spmd_loss(
+                        self.mesh, shared, stage_params, mbs,
+                        embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                        stage_params_layer_dim_spec=P("pp"))
+                    return loss * scale
 
-            loss, grads = jax.value_and_grad(scaled_loss)(state.params)
+                loss, grads = jax.value_and_grad(scaled_loss)(state.params)
             grads = self._constrain(grads, self._grad_specs)
             return self._apply_grads(state, grads, loss, jnp.float32(1.0))
 
@@ -805,7 +862,8 @@ class Engine:
         from ..utils.heartbeat import beat
 
         beat()   # launcher failure detector (no-op unless launched with one)
-        self._require_state()
+        if self._param_offload is None:
+            self._require_state()
         if batch is None:
             if data_iter is None:
                 data_iter = self._train_iter()
@@ -824,15 +882,37 @@ class Engine:
             batch = jax.tree_util.tree_map(relayout, batch)
         if self.curriculum_scheduler is not None:
             # truncate seq dim to the scheduled difficulty (reference
-            # engine.py:1560 curriculum_seqlen injection)
+            # engine.py:1560 curriculum_seqlen injection).  The scheduled
+            # length is rounded UP to a power-of-two bucket (capped at the
+            # batch length): every distinct seqlen is a fresh XLA program,
+            # and a schedule stepping by 8s would compile dozens — buckets
+            # bound that at log2(seq).  Set curriculum_learning
+            # {"exact_seqlen": true} to trade compiles for exact lengths.
             seqlen = self.curriculum_scheduler.update_difficulty(
                 self.global_steps + 1)
-            batch = jax.tree_util.tree_map(
-                lambda x: x[:, :seqlen] if np.ndim(x) >= 2 else x, batch)
+            full = max((np.shape(l)[1] for l in
+                        jax.tree_util.tree_leaves(batch)
+                        if np.ndim(l) >= 2), default=seqlen)
+            if not self.config.curriculum_learning.get("exact_seqlen"):
+                seqlen = min(full, 1 << max(3, (int(seqlen) - 1).bit_length()))
+            if seqlen < full:
+                batch = jax.tree_util.tree_map(
+                    lambda x: x[:, :seqlen] if np.ndim(x) >= 2 else x, batch)
         extra = ()
         if self.progressive_layer_drop is not None:
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             extra = (jnp.float32(theta),)
+        if self._param_offload is not None:
+            loss = self._param_offload.train_batch(batch)
+            self.global_steps += 1
+            self.micro_steps += 1
+            self.global_samples += self.train_batch_size
+            if self.global_steps % self.config.steps_per_print == 0:
+                log_dist(f"step={self.global_steps} "
+                         f"loss={float(jax.device_get(loss)):.4f} "
+                         f"(param-offload={self.param_offload_device})",
+                         ranks=[0])
+            return loss
         batch = self._shard_batch(batch)
         if self.offload_device != "none":
             loss = self._host_offload_train_batch(batch)
@@ -858,6 +938,8 @@ class Engine:
         from ..utils.heartbeat import beat
 
         beat()
+        if self._param_offload is not None:
+            return self._param_offload.eval_loss(batch)
         self._require_state()
         return self._compiled_eval_step(self._state.params, self._shard_batch(batch))
 
@@ -935,12 +1017,17 @@ class Engine:
 
     # checkpointing lives in runtime/checkpointing.py (wired in M3)
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        if self._param_offload is not None:
+            return self._param_offload.save_checkpoint(
+                save_dir, tag=tag, client_state=client_state)
         from .checkpointing import save_checkpoint as _save
 
         self._require_state()
         return _save(self, save_dir, tag=tag, client_state=client_state)
 
     def load_checkpoint(self, load_dir, tag=None, strict: bool = True):
+        if self._param_offload is not None:
+            return self._param_offload.load_checkpoint(load_dir, tag=tag)
         from .checkpointing import load_checkpoint as _load
 
         return _load(self, load_dir, tag=tag, strict=strict)

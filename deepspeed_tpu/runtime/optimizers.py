@@ -35,6 +35,16 @@ def build_optimizer(cfg: OptimizerConfig,
         # non-decoupled mode
         return optax.chain(optax.add_decayed_weights(cfg.weight_decay),
                            optax.adam(lr, b1=b1, b2=b2, eps=cfg.eps))
+    if name in (C.ADAM8BIT_OPTIMIZER, C.ADAMW8BIT_OPTIMIZER):
+        # int8 Adam moments (ops/adam8bit.py): the single-chip analog of
+        # sharding optimizer state across a ZeRO data-parallel group
+        from ..ops.adam8bit import adamw_8bit
+        wd = cfg.weight_decay if name == C.ADAMW8BIT_OPTIMIZER or \
+            cfg.extra.get("adam_w_mode", False) else 0.0
+        tx = adamw_8bit(lr, b1=b1, b2=b2, eps=cfg.eps, weight_decay=wd)
+        if name == C.ADAM8BIT_OPTIMIZER and cfg.weight_decay and not wd:
+            tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+        return tx
     if name == C.LAMB_OPTIMIZER:
         return optax.lamb(lr, b1=b1, b2=b2, eps=cfg.eps,
                           weight_decay=cfg.weight_decay)

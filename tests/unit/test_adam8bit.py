@@ -1,0 +1,97 @@
+"""8-bit Adam state tests — the quantized-state family (ops/adam8bit.py;
+reference compressed-state precedent ``runtime/fp16/onebit/``)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.ops.adam8bit import adamw_8bit
+
+from .simple_model import SimpleModel, token_batch
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _rosenbrockish_losses(tx, steps=60):
+    """Optimize a small quadratic-ish problem; return the loss trace."""
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.normal(size=(24, 24)), jnp.float32) / 5.0
+    b = jnp.asarray(rng.normal(size=(24,)), jnp.float32)
+    params = {"w": jnp.zeros((24, 24)), "c": jnp.zeros((24,))}
+
+    def loss_fn(p):
+        r = p["w"] @ b + p["c"] - A @ b
+        return jnp.sum(r * r) + 0.1 * jnp.sum((p["w"] - A) ** 2)
+
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, state = tx.update(g, state, params)
+        return optax.apply_updates(params, upd), state, loss
+
+    trace = []
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+        trace.append(float(loss))
+    return trace
+
+
+def test_adam8bit_tracks_fp32_adam():
+    ref = _rosenbrockish_losses(optax.adamw(5e-2))
+    q8 = _rosenbrockish_losses(adamw_8bit(5e-2))
+    assert q8[-1] < ref[0] * 0.05          # converges
+    # quantization noise stays small relative to progress
+    assert q8[-1] < ref[-1] * 3 + 1e-3
+
+
+def test_adam8bit_state_dtypes_and_memory():
+    tx = adamw_8bit(1e-3)
+    params = {"k": jnp.zeros((64, 256)), "b": jnp.zeros((256,))}
+    state = tx.init(params)
+    inner = state[0]  # chain: (scale_by_adam8bit, scale_by_lr)
+    assert inner.m_codes["k"].dtype == jnp.int8
+    assert inner.r_codes["k"].dtype == jnp.uint8
+    assert inner.m_codes["k"].shape == (64, 256)
+    assert inner.scales["k"]["m"].shape == (64, 1)
+    # 2 bytes/param codes + per-row scales ≪ 8 bytes/param fp32 moments
+    nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(inner))
+    assert nbytes < 0.4 * sum(
+        8 * l.size for l in jax.tree_util.tree_leaves(params))
+
+
+def test_engine_trains_with_adam8bit_and_checkpoints(tmp_path):
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "adamw8bit",
+                         "params": {"lr": 1e-3, "weight_decay": 0.01}},
+           "gradient_clipping": 1.0,
+           "zero_optimization": {"stage": 1}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 32, 512)
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+    engine.save_checkpoint(str(tmp_path), tag="q8")
+    mesh_mod.set_mesh(None)
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True)),
+        config=cfg)
+    engine2.init_params()
+    engine2.load_checkpoint(str(tmp_path), tag="q8")
+    l2 = [float(engine2.train_batch(batch)) for _ in range(2)]
+    l1 = [float(engine.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)

@@ -170,3 +170,34 @@ def test_flops_profiler_engine():
     assert s["flops"] > 0
     assert s["mean_step_ms"] > 0
     prof.print_profile()
+
+
+def test_curriculum_seqlen_bucketing_bounds_compiles():
+    """Scheduled lengths round up to power-of-two buckets so a schedule
+    stepping by 8s compiles O(log seq) programs, not one per length."""
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+           "curriculum_learning": {
+               "enabled": True, "curriculum_type": "seqlen",
+               "min_difficulty": 8, "max_difficulty": 64,
+               "schedule_type": "fixed_linear",
+               "schedule_config": {"total_curriculum_step": 16,
+                                   "difficulty_step": 8}},
+           "steps_per_print": 10**6}
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 64, 512)
+    # intercept the compiled step to record the seq lengths it receives
+    seen = []
+    inner = engine._compiled_train_step
+
+    def spy(state, b, *extra):
+        seen.append(jax.tree_util.tree_leaves(b)[0].shape[1])
+        return inner(state, b, *extra)
+
+    engine.__dict__["_compiled_train_step"] = spy
+    for _ in range(18):
+        engine.train_batch(batch)
+    # schedule walks 8,16,24,...,64; buckets collapse that to powers of 2
+    assert set(seen) == {8, 16, 32, 64}, sorted(set(seen))

@@ -235,3 +235,27 @@ def test_sparse_gradients_rejects_sharded_params():
             "sparse_gradients": True,
             "sparse_gradient_modules": ["tok_embed"],
             "zero_optimization": {"stage": 3}})
+
+
+def test_chunked_lm_loss_matches_dense():
+    """cfg.loss_chunk computes the same loss/grads as the dense head
+    without materializing (B,S,V) logits (float-reassociation noise only)."""
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    ids = np.random.default_rng(0).integers(0, 512, size=(2, 32)).astype(np.int32)
+
+    def loss_and_gradsum(chunk):
+        cfg = gpt2_config("gpt2-tiny", scan_layers=True, loss_chunk=chunk)
+        m = GPT2LMHeadModel(cfg)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        loss = m.apply({"params": params}, ids, labels=ids)["loss"]
+        g = jax.grad(lambda p: m.apply(
+            {"params": p}, ids, labels=ids)["loss"])(params)
+        gsum = jax.tree_util.tree_reduce(
+            lambda a, b: a + float(jnp.sum(jnp.abs(b))), g, 0.0)
+        return float(loss), float(gsum)
+
+    l0, g0 = loss_and_gradsum(None)
+    l1, g1 = loss_and_gradsum(16)   # 64 rows -> 4 chunks
+    assert abs(l1 - l0) / abs(l0) < 1e-4
+    assert abs(g1 - g0) / g0 < 1e-3

@@ -1,0 +1,130 @@
+"""Execution simulator for pipeline instruction streams.
+
+Cross-validates the schedule MATH in ``parallel/schedule.py`` against
+execution semantics — the check the schedules' own bubble/ordering
+arithmetic cannot provide (a wrong warmup formula self-checks green but
+deadlocks a real interpreter).  The simulator runs every stage's stream
+with BLOCKING send/recv semantics (the reference ``pipe/engine.py:1359``
+interpreter model) and asserts:
+
+- deadlock-freedom: all streams drain with no stage stuck on a recv
+- channel matching: every RecvActivation/RecvGrad consumes a matching
+  prior SendActivation/SendGrad from the correct neighbor/chunk, and no
+  sends are left undelivered
+- each (mb, chunk) forwards exactly once and backwards exactly once,
+  backward after forward
+- live forwarded-not-yet-backwarded activations never exceed the
+  schedule's own num_pipe_buffers() claim
+
+GPipe and 1F1B run through the same harness as known-good anchors (1F1B
+is additionally EXECUTED and exactness-tested in test_pipe_engine.py),
+so a harness bug would show up there first.
+"""
+import pytest
+
+from deepspeed_tpu.parallel.schedule import (GPipeSchedule,
+                                             InterleavedTrainSchedule,
+                                             TrainSchedule)
+
+
+def _simulate(schedules, virtual_stages=1):
+    S = len(schedules)
+    V = virtual_stages
+    queues = [[i for tick in s.steps() for i in tick] for s in schedules]
+    pc = [0] * S
+    act_chan, grad_chan = {}, {}
+    fwd_done = [set() for _ in range(S)]
+    bwd_done = [set() for _ in range(S)]
+    live_peak = [0] * S
+
+    def unpack(packed):
+        return (packed // V, packed % V) if V > 1 else (packed, 0)
+
+    def runnable(s):
+        ins = queues[s][pc[s]]
+        mb, v = unpack(ins.micro_batch_id) if ins.micro_batch_id >= 0 \
+            else (-1, -1)
+        if ins.name == "RecvActivation":
+            return act_chan.get((s, mb, v), 0) > 0
+        if ins.name == "RecvGrad":
+            return grad_chan.get((s, mb, v), 0) > 0
+        return True
+
+    def execute(s):
+        ins = queues[s][pc[s]]
+        mb, v = unpack(ins.micro_batch_id) if ins.micro_batch_id >= 0 \
+            else (-1, -1)
+        n = ins.name
+        if n == "RecvActivation":
+            act_chan[(s, mb, v)] -= 1
+        elif n == "RecvGrad":
+            grad_chan[(s, mb, v)] -= 1
+        elif n == "ForwardPass":
+            assert (mb, v) not in fwd_done[s], f"double fwd {ins} stage {s}"
+            fwd_done[s].add((mb, v))
+            live = len(fwd_done[s]) - len(bwd_done[s])
+            live_peak[s] = max(live_peak[s], live)
+        elif n == "BackwardPass":
+            assert (mb, v) in fwd_done[s], f"bwd before fwd {ins} stage {s}"
+            assert (mb, v) not in bwd_done[s], f"double bwd {ins} stage {s}"
+            bwd_done[s].add((mb, v))
+        elif n == "SendActivation":
+            dst = (0, mb, v + 1) if s == S - 1 else (s + 1, mb, v)
+            act_chan[dst] = act_chan.get(dst, 0) + 1
+        elif n == "SendGrad":
+            dst = (S - 1, mb, v - 1) if s == 0 else (s - 1, mb, v)
+            grad_chan[dst] = grad_chan.get(dst, 0) + 1
+        pc[s] += 1
+
+    while any(pc[s] < len(queues[s]) for s in range(S)):
+        progressed = False
+        for s in range(S):
+            while pc[s] < len(queues[s]) and runnable(s):
+                execute(s)
+                progressed = True
+        if not progressed:
+            stuck = {s: queues[s][pc[s]] for s in range(S)
+                     if pc[s] < len(queues[s])}
+            raise AssertionError(f"DEADLOCK: stages blocked on {stuck}")
+
+    assert all(v == 0 for v in act_chan.values()), "undelivered activations"
+    assert all(v == 0 for v in grad_chan.values()), "undelivered grads"
+    return fwd_done, bwd_done, live_peak
+
+
+@pytest.mark.parametrize("M,S", [(4, 2), (8, 4), (8, 2), (5, 4), (16, 4)])
+@pytest.mark.parametrize("cls", [GPipeSchedule, TrainSchedule])
+def test_plain_schedules_execute(cls, M, S):
+    scheds = [cls(M, S, s) for s in range(S)]
+    fwd, bwd, peak = _simulate(scheds)
+    for s in range(S):
+        assert fwd[s] == {(m, 0) for m in range(M)}
+        assert bwd[s] == fwd[s]
+        assert peak[s] <= scheds[s].num_pipe_buffers(), (
+            s, peak[s], scheds[s].num_pipe_buffers())
+
+
+@pytest.mark.parametrize("M,S", [(8, 4), (16, 4), (8, 2)])
+def test_1f1b_memory_beats_gpipe(M, S):
+    _, _, peak_1f1b = _simulate([TrainSchedule(M, S, s) for s in range(S)])
+    _, _, peak_gpipe = _simulate([GPipeSchedule(M, S, s) for s in range(S)])
+    assert max(peak_gpipe) == M                  # GPipe holds every mb
+    assert max(peak_1f1b) <= S                   # 1F1B bounded by depth
+    if M > S:
+        assert max(peak_1f1b) < max(peak_gpipe)
+
+
+@pytest.mark.parametrize("M,S,V", [(4, 2, 2), (8, 4, 2), (8, 2, 3),
+                                   (8, 4, 4), (12, 4, 2)])
+def test_interleaved_schedule_executes(M, S, V):
+    """The check VERDICT asked for: the interleaved stream must actually
+    RUN under blocking semantics — warmup-depth bugs deadlock here."""
+    scheds = [InterleavedTrainSchedule(M, S, s, virtual_stages=V)
+              for s in range(S)]
+    fwd, bwd, peak = _simulate(scheds, virtual_stages=V)
+    want = {(m, v) for m in range(M) for v in range(V)}
+    for s in range(S):
+        assert fwd[s] == want
+        assert bwd[s] == want
+        assert peak[s] <= scheds[s].num_pipe_buffers(), (
+            s, peak[s], scheds[s].num_pipe_buffers())
