@@ -165,14 +165,15 @@ def bench_train():
     peak = _peak(dev)
 
     if on_tpu:
-        # measured on the bench chip: micro=24 + remat fastest (others OOM
-        # or trail); UNROLLED layers (scan_layers=False) beat the scanned
-        # stack by ~26% — XLA fuses and schedules across layer boundaries.
-        preset, seq, micro, remat, scan = MODEL, SEQ, 24, True, False
+        # round-2 sweep (BENCH_NORTHSTAR.md): micro=24 UNROLLED
+        # (scan_layers=False, +26% over nn.scan) with remat OFF — 125M
+        # activations fit, and skipping recompute buys ~1.5% over the
+        # remat config; micro 16/32, bigger flash tiles, jnp attention,
+        # and the chunked head all trail.
+        preset, seq, micro, remat, scan = MODEL, SEQ, 24, False, False
     else:  # CI / smoke fallback
         preset, seq, micro, remat, scan = "gpt2-tiny", 128, 4, False, True
 
-    # policy sweep at micro=24: dots_with_no_batch_dims_saveable best
     cfg = gpt2_config(preset, n_positions=seq, scan_layers=scan, remat=remat,
                       remat_policy="dots_with_no_batch_dims_saveable",
                       attn_impl="auto")

@@ -41,6 +41,9 @@ class BertConfig:
     initializer_range: float = 0.02
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # weight-only int8 serving (ops/w8.py W8A16); set by init_inference
+    w8: bool = False
+    w8_group: int = 128
     scan_layers: bool = True
     remat: bool = False
     remat_policy: str = "nothing_saveable"
@@ -76,11 +79,18 @@ def bert_config(preset: str = "bert-base", **overrides) -> BertConfig:
 
 
 def _dense(x, features, names, *, cfg, name, module, use_bias=True):
-    kernel = module.param(
-        name + "_kernel",
-        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
-        (x.shape[-1], features), cfg.param_dtype)
-    y = jnp.dot(x, kernel.astype(cfg.dtype))
+    if getattr(cfg, "w8", False):
+        from ..ops.w8 import declare_w8_dense, w8a16_matmul
+
+        codes, scale = declare_w8_dense(module, name, names, x.shape[-1],
+                                        features, cfg.w8_group)
+        y = w8a16_matmul(x, codes, scale)
+    else:
+        kernel = module.param(
+            name + "_kernel",
+            nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+            (x.shape[-1], features), cfg.param_dtype)
+        y = jnp.dot(x, kernel.astype(cfg.dtype))
     if use_bias:
         bias = module.param(name + "_bias",
                             nn.with_partitioning(nn.initializers.zeros, (names[-1],)),

@@ -124,18 +124,10 @@ def _dense(x, features, names, *, cfg: GPT2Config, name: str, module: nn.Module,
         # int8 codes + grouped scales declared IN PLACE of the fp kernel
         # (ops/w8.py W8A16 path); names line up with what
         # quantize_dense_tree emits from a trained checkpoint
-        from ..ops.w8 import w8a16_matmul
+        from ..ops.w8 import declare_w8_dense, w8a16_matmul
 
-        K = x.shape[-1]
-        g = cfg.w8_group if K % cfg.w8_group == 0 else K
-        codes = module.param(
-            name + "_kernel_q",
-            nn.with_partitioning(nn.initializers.zeros, names),
-            (K, features), jnp.int8)
-        scale = module.param(
-            name + "_kernel_s",
-            nn.with_partitioning(nn.initializers.ones, (None, names[-1])),
-            (K // g, features), jnp.float32)
+        codes, scale = declare_w8_dense(module, name, names, x.shape[-1],
+                                        features, cfg.w8_group)
         y = w8a16_matmul(x, codes, scale)
         bias = module.param(
             name + "_bias",

@@ -42,6 +42,9 @@ class GPTNeoXConfig:
     attn_impl: str = "auto"
     vocab_pad_multiple: int = 128
     decode: bool = False
+    # weight-only int8 serving (ops/w8.py W8A16); set by init_inference
+    w8: bool = False
+    w8_group: int = 128
     moe: Optional[Any] = None
 
     @property
@@ -76,14 +79,22 @@ def gptneox_config(preset: str = "neox-tiny", **overrides) -> GPTNeoXConfig:
 
 
 def _dense(x, features, names, *, cfg, name, module):
-    kernel = module.param(
-        name + "_kernel",
-        nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
-        (x.shape[-1], features), cfg.param_dtype)
+    if getattr(cfg, "w8", False):
+        from ..ops.w8 import declare_w8_dense, w8a16_matmul
+
+        codes, scale = declare_w8_dense(module, name, names, x.shape[-1],
+                                        features, cfg.w8_group)
+        y = w8a16_matmul(x, codes, scale)
+    else:
+        kernel = module.param(
+            name + "_kernel",
+            nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),
+            (x.shape[-1], features), cfg.param_dtype)
+        y = jnp.dot(x, kernel.astype(cfg.dtype))
     bias = module.param(name + "_bias",
                         nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
                         (features,), cfg.param_dtype)
-    return jnp.dot(x, kernel.astype(cfg.dtype)) + bias.astype(cfg.dtype)
+    return y + bias.astype(cfg.dtype)
 
 
 class NeoXLayerNorm(nn.Module):

@@ -40,6 +40,9 @@ class LlamaConfig:
     attn_impl: str = "auto"
     vocab_pad_multiple: int = 128
     decode: bool = False
+    # weight-only int8 serving (ops/w8.py W8A16); set by init_inference
+    w8: bool = False
+    w8_group: int = 128
 
     @property
     def padded_vocab_size(self) -> int:
@@ -74,6 +77,14 @@ def llama_config(preset: str = "llama-tiny", **overrides) -> LlamaConfig:
 
 
 def _dense(x, features, names, *, cfg, name, module):
+    if cfg.w8:
+        # int8 codes + grouped scales (ops/w8.py; names match
+        # quantize_dense_tree's output from a trained checkpoint)
+        from ..ops.w8 import declare_w8_dense, w8a16_matmul
+
+        codes, scale = declare_w8_dense(module, name, names, x.shape[-1],
+                                        features, cfg.w8_group)
+        return w8a16_matmul(x, codes, scale)
     kernel = module.param(
         name + "_kernel",
         nn.with_partitioning(nn.initializers.normal(cfg.initializer_range), names),

@@ -48,7 +48,9 @@ def convert_hf_model(hf_model, dtype=None):
 
 
 def _np(t) -> np.ndarray:
-    return t.detach().cpu().numpy()
+    if hasattr(t, "detach"):          # torch tensor
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
 
 
 def _stack_t(sd: dict, L: int, fmt: str) -> np.ndarray:
@@ -481,3 +483,123 @@ def convert_hf_gptj(hf_model, dtype=None):
     }
     logger.info(f"converted HF GPT-J ({L}L, {cfg.hidden_size}d) to zoo params")
     return GPTJForCausalLM(cfg), _tree_f32(params)
+
+
+def convert_megatron_gpt2(sd: dict, n_head: int, dtype=None,
+                          layer_norm_epsilon: float = 1e-5,
+                          interleaved_qkv: bool = True,
+                          true_vocab_size=None):
+    """Megatron-LM GPT-2 checkpoint (raw state dict) → zoo model + params.
+
+    The dedicated Megatron policy the HF ones don't cover (reference
+    ``replace_policy.py:203`` ``MegatronLayerPolicy``).  Differences from
+    HF GPT-2 a generic name-map misses:
+
+    - weights are (out, in) Linear layout → transposed here;
+    - ``attention.query_key_value`` packs heads INTERLEAVED on the out
+      dim as [H, 3, head_dim] in megatron_v2-style checkpoints — the
+      layout the reference de-interleaves when ``megatron_v2`` is set
+      (``replace_module.py`` ``_transpose``).  That is the default here
+      (``interleaved_qkv=True``); pass ``False`` for older checkpoints
+      whose qkv is already contiguous q|k|v;
+    - ``true_vocab_size``: Megatron pads wte to a multiple for MP; pass
+      the tokenizer's real vocab so pad rows are masked out of the
+      softmax (defaults to wte's row count = no masking);
+    - layernorms are ``input_layernorm`` / ``post_attention_layernorm`` /
+      ``final_layernorm``.
+
+    ``sd``: flat dict of numpy/torch tensors with classic Megatron names
+    (any common prefix like ``model.language_model.`` is stripped).
+    """
+    import jax.numpy as jnp
+    import re as _re
+
+    from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    sd = {k: _np(v) for k, v in sd.items()}
+    # strip any common prefix before the canonical names
+    def find(suffix):
+        hits = [k for k in sd if k.endswith(suffix)]
+        if len(hits) != 1:
+            raise KeyError(f"expected exactly one key ending {suffix!r}, "
+                           f"found {hits}")
+        return sd[hits[0]]
+
+    wte = find("word_embeddings.weight").astype(np.float32)
+    wpe = find("position_embeddings.weight").astype(np.float32)
+    E = wte.shape[1]
+    layer_ids = sorted({int(m.group(1)) for k in sd
+                        for m in [_re.search(r"layers\.(\d+)\.", k)] if m})
+    L = len(layer_ids)
+    if layer_ids != list(range(L)):
+        raise ValueError(f"non-contiguous layer ids {layer_ids}")
+    dh = E // n_head
+
+    def lay(i, suffix):
+        return find(f"layers.{i}.{suffix}")
+
+    def de_interleave_w(w):           # (3E, E) → (E, 3E) contiguous q|k|v
+        if interleaved_qkv:
+            w = w.reshape(n_head, 3, dh, E).transpose(1, 0, 2, 3)
+        return w.reshape(3 * E, E).T
+
+    def de_interleave_b(b):
+        if interleaved_qkv:
+            b = b.reshape(n_head, 3, dh).transpose(1, 0, 2)
+        return b.reshape(3 * E)
+
+    vocab = int(true_vocab_size or wte.shape[0])
+    if not 0 < vocab <= wte.shape[0]:
+        raise ValueError(f"true_vocab_size {vocab} vs wte rows {wte.shape[0]}")
+    cfg = GPT2Config(
+        # padded_vocab_size resolves to wte's (already mp-padded) row
+        # count, and ids >= true vocab get the -inf logit mask
+        vocab_size=vocab, n_positions=wpe.shape[0], n_embd=E,
+        n_layer=L, n_head=n_head, layer_norm_epsilon=layer_norm_epsilon,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        vocab_pad_multiple=wte.shape[0],
+        scan_layers=True)
+
+    params = {
+        "wte": wte,
+        "wpe": wpe,
+        "ln_f": {"scale": find("final_layernorm.weight"),
+                 "bias": find("final_layernorm.bias")},
+        "h": {
+            "ln_1": {"scale": np.stack(
+                         [lay(i, "input_layernorm.weight") for i in range(L)]),
+                     "bias": np.stack(
+                         [lay(i, "input_layernorm.bias") for i in range(L)])},
+            "ln_2": {"scale": np.stack(
+                         [lay(i, "post_attention_layernorm.weight")
+                          for i in range(L)]),
+                     "bias": np.stack(
+                         [lay(i, "post_attention_layernorm.bias")
+                          for i in range(L)])},
+            "attn": {
+                "c_attn_kernel": np.stack(
+                    [de_interleave_w(lay(i, "attention.query_key_value.weight"))
+                     for i in range(L)]),
+                "c_attn_bias": np.stack(
+                    [de_interleave_b(lay(i, "attention.query_key_value.bias"))
+                     for i in range(L)]),
+                "c_proj_kernel": np.stack(
+                    [lay(i, "attention.dense.weight").T for i in range(L)]),
+                "c_proj_bias": np.stack(
+                    [lay(i, "attention.dense.bias") for i in range(L)]),
+            },
+            "mlp": {
+                "c_fc_kernel": np.stack(
+                    [lay(i, "mlp.dense_h_to_4h.weight").T for i in range(L)]),
+                "c_fc_bias": np.stack(
+                    [lay(i, "mlp.dense_h_to_4h.bias") for i in range(L)]),
+                "c_proj_kernel": np.stack(
+                    [lay(i, "mlp.dense_4h_to_h.weight").T for i in range(L)]),
+                "c_proj_bias": np.stack(
+                    [lay(i, "mlp.dense_4h_to_h.bias") for i in range(L)]),
+            },
+        },
+    }
+    params = {k: _tree_f32(v) for k, v in params.items()}
+    logger.info(f"converted Megatron GPT-2 ({L}L, {E}d, {n_head}h)")
+    return GPT2LMHeadModel(cfg), params

@@ -62,6 +62,25 @@ def w8a16_matmul(x: jax.Array, codes: jax.Array, scale: jax.Array):
     return y.astype(x.dtype)
 
 
+def declare_w8_dense(module, name: str, names: tuple, in_features: int,
+                     features: int, group: int):
+    """Declare the (codes, scales) param pair a W8A16 dense layer stores
+    IN PLACE of its fp kernel — shared by every model family's ``_dense``
+    so the names/shapes always line up with :func:`quantize_dense_tree`."""
+    import flax.linen as nn
+
+    g = group if in_features % group == 0 else in_features
+    codes = module.param(
+        name + "_kernel_q",
+        nn.with_partitioning(nn.initializers.zeros, names),
+        (in_features, features), jnp.int8)
+    scale = module.param(
+        name + "_kernel_s",
+        nn.with_partitioning(nn.initializers.ones, (None, names[-1])),
+        (in_features // g, features), jnp.float32)
+    return codes, scale
+
+
 def quantize_dense_tree(params, group: int = 128, suffix: str = "_kernel"):
     """Convert every 2-D ``*_kernel`` leaf of a host param tree to the
     serving layout: ``name_q`` int8 codes + ``name_s`` fp32 scales.
