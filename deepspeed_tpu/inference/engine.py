@@ -121,11 +121,8 @@ class InferenceEngine:
                 self.model_cfg = dataclasses.replace(
                     self.model_cfg, w8=True,
                     w8_group=int(q.get("group_size", 128)))
-                if getattr(cfg, "moe", None) is not None:
-                    logger.warning(
-                        "int8 serving quantizes dense *_kernel weights "
-                        "only; MoE expert weights (wi/wo/wg) stay full "
-                        "width this round")
+                # dense *_kernel AND MoE expert wi/wo leaves quantize;
+                # only the tiny gate (wg) stays full width
         # models name their context-length field differently
         pos_field = "n_positions" if hasattr(cfg, "n_positions") \
             else "max_position_embeddings"

@@ -300,7 +300,8 @@ class Block(nn.Module):
 
             ff, aux = MoELayer(self.cfg.moe, model_dim=self.cfg.n_embd,
                                hidden_dim=4 * self.cfg.n_embd,
-                               dtype=self.cfg.dtype, name="moe")(
+                               dtype=self.cfg.dtype, w8=self.cfg.w8,
+                               w8_group=self.cfg.w8_group, name="moe")(
                 h, train=not self.deterministic)
             x = x + survive(ff)
             return x, aux

@@ -174,7 +174,8 @@ class NeoXBlock(nn.Module):
 
             mlp, aux = MoELayer(cfg.moe, model_dim=cfg.hidden_size,
                                 hidden_dim=cfg.intermediate_size,
-                                dtype=cfg.dtype, name="moe")(
+                                dtype=cfg.dtype, w8=cfg.w8,
+                                w8_group=cfg.w8_group, name="moe")(
                 h_in, train=not self.deterministic)
         else:
             h = _dense(h_in, cfg.intermediate_size, ("embed", "mlp"), cfg=cfg,

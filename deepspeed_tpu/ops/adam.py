@@ -41,18 +41,29 @@ class DeepSpeedCPUAdam:
         self.exp_avg_sq = np.zeros(param_size, np.float32)
         self._lib = _load_native()
 
-    def step(self, params: np.ndarray, grads: np.ndarray,
-             lr: Optional[float] = None) -> None:
-        assert params.dtype == np.float32 and params.flags.c_contiguous
+    def begin_step(self) -> None:
+        """Advance the shared step count once per optimizer step; the
+        following :meth:`step_slice` calls all use its bias correction."""
         self.t += 1
+
+    def step_slice(self, params: np.ndarray, grads: np.ndarray,
+                   offset: int = 0, lr: Optional[float] = None) -> None:
+        """Fused update of ``params[offset:offset+len(grads)]`` (and the
+        matching moment slices) at the CURRENT step count — lets the
+        engine stream grads leaf-by-leaf (transfer/update overlap) and
+        partition the update range across processes."""
+        assert params.dtype == np.float32 and params.flags.c_contiguous
         lr = self.lr if lr is None else lr
         bc1 = 1.0 - self.beta1 ** self.t
         bc2 = 1.0 - self.beta2 ** self.t
+        n = grads.size
         grads = np.ascontiguousarray(grads, np.float32)
+        p = params[offset:offset + n]
+        m = self.exp_avg[offset:offset + n]
+        v = self.exp_avg_sq[offset:offset + n]
         if self._lib is not None:
             self._lib.ds_adam_step(
-                _f32p(params), _f32p(grads), _f32p(self.exp_avg),
-                _f32p(self.exp_avg_sq), params.size,
+                _f32p(p), _f32p(grads), _f32p(m), _f32p(v), n,
                 ctypes.c_float(lr), ctypes.c_float(self.beta1),
                 ctypes.c_float(self.beta2), ctypes.c_float(self.eps),
                 ctypes.c_float(self.weight_decay), ctypes.c_float(bc1),
@@ -61,15 +72,20 @@ class DeepSpeedCPUAdam:
         # numpy fallback (same math)
         g = grads
         if not self.adamw_mode and self.weight_decay:
-            g = g + self.weight_decay * params
-        self.exp_avg *= self.beta1
-        self.exp_avg += (1 - self.beta1) * g
-        self.exp_avg_sq *= self.beta2
-        self.exp_avg_sq += (1 - self.beta2) * g * g
-        denom = np.sqrt(self.exp_avg_sq / bc2) + self.eps
+            g = g + self.weight_decay * p
+        m *= self.beta1
+        m += (1 - self.beta1) * g
+        v *= self.beta2
+        v += (1 - self.beta2) * g * g
+        denom = np.sqrt(v / bc2) + self.eps
         if self.adamw_mode and self.weight_decay:
-            params -= lr * self.weight_decay * params
-        params -= (lr / bc1) * self.exp_avg / denom
+            p -= lr * self.weight_decay * p
+        p -= (lr / bc1) * m / denom
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        self.begin_step()
+        self.step_slice(params, grads, offset=0, lr=lr)
 
 
 class DeepSpeedCPUAdagrad:

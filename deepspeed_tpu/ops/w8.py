@@ -29,7 +29,7 @@ def quantize_weight(w: jax.Array, group: int = 128):
     Symmetric absmax per (group, out-channel); ``group`` falls back to K
     when it does not divide K.  A 3-D input is a scanned layer stack
     (L, K, N) and quantizes per layer."""
-    if w.ndim == 3:
+    if w.ndim in (3, 4):   # scanned stack and/or expert leading dims
         codes, scale = jax.vmap(lambda l: quantize_weight(l, group))(
             jnp.asarray(w))
         return codes, scale
@@ -81,10 +81,37 @@ def declare_w8_dense(module, name: str, names: tuple, in_features: int,
     return codes, scale
 
 
+def w8a16_expert_matmul(x: jax.Array, codes: jax.Array, scale: jax.Array):
+    """Per-expert W8A16: ``x`` (E, C, K) × int8 codes (E, K, N) with
+    scales (E, G, N) → (E, C, N).  The MoE ``ExpertsMLP`` analog of
+    :func:`w8a16_matmul`."""
+    E, K, N = codes.shape
+    G = scale.shape[1]
+    g = K // G
+    cdt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.bfloat16
+    xg = x.reshape(E, -1, G, g)
+    cg = codes.reshape(E, G, g, N)
+    part = jnp.einsum("ecug,eugn->ecun", xg.astype(cdt), cg.astype(cdt))
+    y = jnp.einsum("ecun,eun->ecn", part.astype(jnp.float32), scale)
+    return y.astype(x.dtype)
+
+
+# expert FFN leaves (parallel/moe.py ExpertsMLP) quantized alongside the
+# dense ``*_kernel`` family
+_EXPERT_KEYS = ("wi", "wo")
+
+
 def quantize_dense_tree(params, group: int = 128, suffix: str = "_kernel"):
-    """Convert every 2-D ``*_kernel`` leaf of a host param tree to the
-    serving layout: ``name_q`` int8 codes + ``name_s`` fp32 scales.
-    Embeddings / norms / biases pass through at full width."""
+    """Convert every dense ``*_kernel`` leaf (2-D, or 3-D scanned stack)
+    and MoE expert ``wi``/``wo`` leaf (3-D, or 4-D scanned stack) of a
+    host param tree to the serving layout: ``name_q`` int8 codes +
+    ``name_s`` fp32 scales.  Embeddings / norms / biases / gates pass
+    through at full width."""
+    def wants(k, v):
+        if k.endswith(suffix) and np.ndim(v) in (2, 3):
+            return True
+        return k in _EXPERT_KEYS and np.ndim(v) in (3, 4)
+
     def convert(subtree):
         if not isinstance(subtree, dict):
             return subtree
@@ -92,7 +119,7 @@ def quantize_dense_tree(params, group: int = 128, suffix: str = "_kernel"):
         for k, v in subtree.items():
             if isinstance(v, dict):
                 out[k] = convert(v)
-            elif k.endswith(suffix) and np.ndim(v) in (2, 3):
+            elif wants(k, v):
                 codes, scale = quantize_weight(jnp.asarray(v), group)
                 out[k + "_q"] = np.asarray(codes)
                 out[k + "_s"] = np.asarray(scale)

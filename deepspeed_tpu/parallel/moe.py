@@ -158,9 +158,33 @@ class ExpertsMLP(nn.Module):
     hidden_dim: int
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    w8: bool = False                   # int8 expert weights (ops/w8.py)
+    w8_group: int = 128
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:   # (E, C, M)
+        if self.w8:
+            from ..ops.w8 import w8a16_expert_matmul
+
+            def qparams(name, K, N, names):
+                # codes keep the fp kernel's logical axes (TP sharding
+                # intact); the grouped-scale K/g dim replicates
+                g = self.w8_group if K % self.w8_group == 0 else K
+                codes = self.param(name + "_q", nn.with_partitioning(
+                    nn.initializers.zeros, names),
+                    (self.num_experts, K, N), jnp.int8)
+                scale = self.param(name + "_s", nn.with_partitioning(
+                    nn.initializers.ones, (names[0], None, names[-1])),
+                    (self.num_experts, K // g, N), jnp.float32)
+                return codes, scale
+
+            wi_q, wi_s = qparams("wi", self.model_dim, self.hidden_dim,
+                                 ("experts", "embed", "mlp"))
+            wo_q, wo_s = qparams("wo", self.hidden_dim, self.model_dim,
+                                 ("experts", "mlp", "embed"))
+            h = nn.gelu(w8a16_expert_matmul(x, wi_q, wi_s),
+                        approximate=True)
+            return w8a16_expert_matmul(h, wo_q, wo_s)
         wi = self.param("wi", nn.with_partitioning(
             nn.initializers.normal(0.02), ("experts", "embed", "mlp")),
             (self.num_experts, self.model_dim, self.hidden_dim), self.param_dtype)
@@ -185,6 +209,8 @@ class MoELayer(nn.Module):
     model_dim: int
     hidden_dim: int
     dtype: Any = jnp.bfloat16
+    w8: bool = False                   # int8 expert weights for serving
+    w8_group: int = 128
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False):
@@ -196,7 +222,9 @@ class MoELayer(nn.Module):
         dispatched = jnp.einsum("sec,sm->ecm", dispatch.astype(self.dtype), x2)
         dispatched = _constrain_ep(dispatched)                    # all-to-all in
         expert_out = ExpertsMLP(cfg.num_experts, self.model_dim, self.hidden_dim,
-                                dtype=self.dtype, name="experts")(dispatched)
+                                dtype=self.dtype, w8=self.w8,
+                                w8_group=self.w8_group,
+                                name="experts")(dispatched)
         expert_out = _constrain_ep(expert_out)                    # all-to-all out
         out = jnp.einsum("sec,ecm->sm", combine.astype(self.dtype), expert_out)
 

@@ -203,6 +203,45 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
     return loss, g_sh, g_st
 
 
+def interleaved_spmd_grads(mesh, shared_params, stage_params, microbatches,
+                           scale, *, embed_fn, stage_fn, loss_fn,
+                           virtual_stages, stage_params_layer_dim_spec,
+                           axis: str = "pp"):
+    """shard_map wrapper for :func:`interleaved_1f1b_loss_and_grads`.
+
+    ``stage_params`` arrives in GLOBAL layer order; the permutation into
+    local-slot order (and its inverse on the grads) happens here so
+    callers never see the interleaved layout."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as Pspec
+
+    S = mesh.shape[axis]
+    V = virtual_stages
+    # NOTE: permuting per step regathers the pp-sharded layer stack (an
+    # all-to-all); a production engine would store params pre-permuted.
+    perm, inv = interleaved_perm(S, V)
+
+    def permute(tree, order):
+        def leaf(l):
+            Lc = l.shape[0] // (S * V)
+            chunks = l.reshape((S * V, Lc) + l.shape[1:])
+            return chunks[jnp.asarray(order)].reshape(l.shape)
+
+        return jax.tree_util.tree_map(leaf, tree)
+
+    fn = functools.partial(interleaved_1f1b_loss_and_grads,
+                           embed_fn=embed_fn, stage_fn=stage_fn,
+                           loss_fn=loss_fn, virtual_stages=V, axis=axis)
+    loss, g_sh, g_st = shard_map(
+        fn, mesh=mesh,
+        in_specs=(Pspec(), stage_params_layer_dim_spec, Pspec(), Pspec()),
+        out_specs=(Pspec(), Pspec(), stage_params_layer_dim_spec),
+        check_vma=False,
+        axis_names={axis},
+    )(shared_params, permute(stage_params, perm), microbatches, scale)
+    return loss, g_sh, permute(g_st, inv)
+
+
 def onef1b_spmd_grads(mesh, shared_params, stage_params, microbatches, scale,
                       *, embed_fn, stage_fn, loss_fn,
                       stage_params_layer_dim_spec, axis: str = "pp"):
@@ -221,6 +260,155 @@ def onef1b_spmd_grads(mesh, shared_params, stage_params, microbatches, scale,
         check_vma=False,
         axis_names={axis},
     )(shared_params, stage_params, microbatches, scale)
+
+
+def interleaved_perm(stages: int, virtual: int):
+    """Layer permutation placing global chunk ``g = v·S + s`` in stage
+    ``s``'s local slot ``v`` (Megatron interleaved placement).  Returns
+    (perm, inv_perm) over the P = S·V chunk indices; apply to the stacked
+    layer dim reshaped (P, L/P, ...)."""
+    S, V = stages, virtual
+    perm = [v * S + s for s in range(S) for v in range(V)]
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return perm, inv
+
+
+def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
+                                    microbatches, scale, *,
+                                    embed_fn: Callable, stage_fn: Callable,
+                                    loss_fn: Callable, virtual_stages: int,
+                                    axis: str = "pp"):
+    """EXECUTED interleaved 1F1B (Megatron virtual stages; the schedule
+    math lives in ``schedule.py:InterleavedTrainSchedule``).
+
+    Each physical stage hosts ``V`` model chunks; global chunk
+    ``g = v·S + s`` runs in stage ``s``'s local slot ``v``, so activations
+    traverse the ring V times and the pipeline behaves as ``P = S·V``
+    virtual stages — the bubble shrinks to (S-1)/(V·M) of the plain
+    schedule's.  Same explicit-vjp clocking as
+    :func:`onef1b_loss_and_grads` with g in place of the stage index:
+    stage s slot v forwards ``f = t - g`` and backwards
+    ``k = t - (2P-2-g)`` at tick t; ticks ``T = M + 2P - 2``; per-slot
+    rotating residual depth ``D = 2P - 1``.
+
+    Ring wiring per tick: the stacked (V, …) activation buffer ppermutes
+    one hop down, and stage 0 additionally ROLLS it one slot (chunk v-1's
+    output from the last stage becomes slot v's input — the wrap that
+    makes V ring laps one logical pipeline); cotangents mirror upward
+    with the inverse roll at the last stage.
+
+    ``stage_params``: leading dim ``V·Lc`` laid out in local-slot order
+    (apply :func:`interleaved_perm` BEFORE sharding over ``axis``).
+    Returns ``(loss, shared_grads, stage_grads)`` with stage grads in the
+    same local-slot layout.
+    """
+    S = lax.axis_size(axis)
+    sid = lax.axis_index(axis)
+    V = virtual_stages
+    P = S * V
+    leaves = jax.tree_util.tree_leaves(microbatches)
+    M = leaves[0].shape[0]
+    T = M + 2 * P - 2
+    D = 2 * P - 1
+
+    def pick_mb(t):
+        idx = jnp.clip(t, 0, M - 1)
+        return jax.tree_util.tree_map(
+            lambda x: lax.dynamic_index_in_dim(x, idx, 0, keepdims=False),
+            microbatches)
+
+    def chunk_params(v):
+        return jax.tree_util.tree_map(
+            lambda l: l.reshape((V, l.shape[0] // V) + l.shape[1:])[v],
+            stage_params)
+
+    mb0 = pick_mb(jnp.int32(0))
+    h_sds = jax.eval_shape(lambda: embed_fn(shared_params, mb0))
+    f32 = jnp.float32
+    zeros_h = lambda lead: jnp.zeros(lead + h_sds.shape, h_sds.dtype)
+    fwd0 = _pvary(zeros_h((V,)), axis)
+    ct0 = _pvary(zeros_h((V,)), axis)
+    resid0 = _pvary(zeros_h((V, D)), axis)
+    g_sh0 = _pvary(jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, f32), shared_params), axis)
+    g_st0 = _pvary(jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, f32), stage_params), axis)
+    loss0 = _pvary(jnp.zeros((), f32), axis)
+
+    def tick(carry, t):
+        fwd_buf, ct_buf, resid, g_sh, g_st, loss_acc = carry
+        ys, cts = [], []
+        for v in range(V):            # static unroll over local chunks
+            params_v = chunk_params(v)
+            g = v * S + sid
+            # ---- forward ----
+            f = t - g
+            do_fwd = jnp.logical_and(f >= 0, f < M)
+            mb_f = pick_mb(f)
+            x = fwd_buf[v]
+            if v == 0:                # only global chunk 0 ingests tokens
+                x = jnp.where(sid == 0, embed_fn(shared_params, mb_f), x)
+            ys.append(stage_fn(params_v, x))
+            slot_f = jnp.mod(jnp.maximum(f, 0), D)
+            resid = jnp.where(
+                do_fwd,
+                resid.at[v].set(lax.dynamic_update_index_in_dim(
+                    resid[v], x, slot_f, 0)),
+                resid)
+            # ---- backward ----
+            k = t - (2 * P - 2 - g)
+            do_bwd = jnp.logical_and(k >= 0, k < M)
+            mb_k = pick_mb(k)
+            x_k = lax.dynamic_index_in_dim(
+                resid[v], jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
+            y_k, stage_vjp = jax.vjp(stage_fn, params_v, x_k)
+            if v == V - 1:            # final chunk: loss head seeds ct
+                loss_k, head_vjp = jax.vjp(
+                    lambda sh, h: loss_fn(sh, h, mb_k), shared_params, y_k)
+                g_head_sh, ct_loss = head_vjp(
+                    (scale / M).astype(loss_k.dtype))
+                is_final = sid == S - 1
+                ct_y = jnp.where(is_final, ct_loss, ct_buf[v])
+                m_head = do_bwd.astype(f32) * is_final.astype(f32)
+                g_sh = jax.tree_util.tree_map(
+                    lambda a, b: a + m_head * b.astype(f32), g_sh, g_head_sh)
+                loss_acc = loss_acc + jnp.where(
+                    jnp.logical_and(is_final, do_bwd),
+                    loss_k.astype(f32) * scale, 0.0)
+            else:
+                ct_y = ct_buf[v]
+            g_st_v, ct_x = stage_vjp(ct_y)
+            if v == 0:                # global chunk 0: embed backward
+                g_emb_sh = jax.vjp(
+                    lambda sh: embed_fn(sh, mb_k), shared_params)[1](ct_x)[0]
+                m_emb = do_bwd.astype(f32) * (sid == 0).astype(f32)
+                g_sh = jax.tree_util.tree_map(
+                    lambda a, b: a + m_emb * b.astype(f32), g_sh, g_emb_sh)
+            m_bwd = do_bwd.astype(f32)
+            cts.append(ct_x)
+            # accumulate chunk grads into the stacked local-slot layout
+            g_st = jax.tree_util.tree_map(
+                lambda acc, gv: acc.reshape(
+                    (V, acc.shape[0] // V) + acc.shape[1:]).at[v].add(
+                        m_bwd * gv.astype(f32)).reshape(acc.shape),
+                g_st, g_st_v)
+
+        ys = jnp.stack(ys)            # (V, ...)
+        cts = jnp.stack(cts)
+        down = lax.ppermute(ys, axis, [(i, (i + 1) % S) for i in range(S)])
+        up = lax.ppermute(cts, axis, [(i, (i - 1) % S) for i in range(S)])
+        fwd_buf = jnp.where(sid == 0, jnp.roll(down, 1, axis=0), down)
+        ct_buf = jnp.where(sid == S - 1, jnp.roll(up, -1, axis=0), up)
+        return (fwd_buf, ct_buf, resid, g_sh, g_st, loss_acc), None
+
+    carry0 = (fwd0, ct0, resid0, g_sh0, g_st0, loss0)
+    (_, _, _, g_sh, g_st, loss_sum), _ = lax.scan(tick, carry0,
+                                                  jnp.arange(T))
+    loss = lax.psum(loss_sum, axis) / M
+    g_sh = lax.psum(g_sh, axis)
+    return loss, g_sh, g_st
 
 
 def pipeline_spmd_loss(mesh, shared_params, stage_params, microbatches, *,

@@ -719,25 +719,70 @@ class Engine:
             else:
                 loss, g = self._grads_of(state.params, batch, rng, jnp.float32(1.0))
             g = jax.tree_util.tree_map(lambda x: x / gas, g)
-            return loss / gas, g
+            # global norm computed ON DEVICE so the host never needs the
+            # whole grad tree just to decide the clip factor
+            return loss / gas, g, optax.global_norm(g)
 
         return jax.jit(grads_fn)
 
     def _host_offload_train_batch(self, batch):
-        loss, grads = self._compiled_grads_only(self._state, batch)
-        flat = np.concatenate([np.asarray(l, np.float32).ravel()
-                               for l in jax.tree_util.tree_leaves(
-                                   jax.device_get(grads))])
-        if self.config.gradient_clipping > 0:
-            norm = float(np.linalg.norm(flat))
-            clip = self.config.gradient_clipping
+        """ZeRO-Offload step (reference ``stage_1_and_2.py`` cpu_offload):
+        grads stream to host LEAF BY LEAF (all device→host copies issued
+        async up front, so leaf k+1 transfers while leaf k's CPU-Adam
+        slice runs), each process updates only its 1/world slice of the
+        flat master, and slices are allgathered host-side before
+        re-placement."""
+        loss, grads, gnorm = self._compiled_grads_only(self._state, batch)
+        leaves = jax.tree_util.tree_leaves(grads)
+        for l in leaves:
+            l.copy_to_host_async()
+        clip = self.config.gradient_clipping
+        clip_scale = 1.0
+        if clip > 0:
+            norm = float(jax.device_get(gnorm))
             if norm > clip:
-                flat *= clip / norm
+                clip_scale = clip / norm
         lr = float(jax.device_get(self.lr_scheduler(self._state.step))) \
             if callable(self.lr_scheduler) else self.config.optimizer.lr
         if self._swapper is not None:
             self._swap_states_in()
-        self._cpu_opt.step(self._host_master, flat, lr=lr)
+        n = self._host_master.size
+        world, rank = jax.process_count(), jax.process_index()
+        lo, hi = rank * n // world, (rank + 1) * n // world
+        if hasattr(self._cpu_opt, "begin_step"):
+            self._cpu_opt.begin_step()
+            offset = 0
+            for leaf, size in zip(leaves, self._host_sizes):
+                s, e = offset, offset + size
+                offset = e
+                if e <= lo or s >= hi:
+                    continue               # outside this rank's partition
+                g = np.asarray(leaf, np.float32).ravel()
+                if clip_scale != 1.0:
+                    g = g * clip_scale
+                a, b = max(lo, s) - s, min(hi, e) - s
+                self._cpu_opt.step_slice(self._host_master, g[a:b],
+                                         offset=s + a, lr=lr)
+        else:                              # adagrad path: whole-buffer
+            flat = np.concatenate([np.asarray(l, np.float32).ravel()
+                                   for l in leaves])
+            if clip_scale != 1.0:
+                flat *= clip_scale
+            self._cpu_opt.step(self._host_master, flat, lr=lr)
+        if world > 1:
+            # exchange updated slices so every host holds the full master
+            # (each rank ran CPU-Adam on 1/world of the params)
+            from jax.experimental import multihost_utils
+
+            psize = -(-n // world)
+            mine = np.zeros(psize, np.float32)
+            mine[:hi - lo] = self._host_master[lo:hi]
+            allp = np.asarray(multihost_utils.process_allgather(mine))
+            flat_all = allp.reshape(-1)
+            for r in range(world):
+                rlo, rhi = r * n // world, (r + 1) * n // world
+                self._host_master[rlo:rhi] = \
+                    flat_all[r * psize:r * psize + (rhi - rlo)]
         if self._swapper is not None:
             self._swap_states_out()
         # re-place updated master weights with the training shardings
@@ -759,16 +804,21 @@ class Engine:
         """Train step when mesh pp>1: grad-accumulation micro-batches ARE
         the pipeline micro-batches; the whole GPipe wave is one scan (see
         ``parallel/pipeline.py``)."""
-        from ..parallel.pipeline import onef1b_spmd_grads, pipeline_spmd_loss
+        from ..parallel.pipeline import (interleaved_spmd_grads,
+                                         onef1b_spmd_grads,
+                                         pipeline_spmd_loss)
 
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         schedule = cfg.pipeline.get("schedule", "gpipe")
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"pipeline.schedule must be gpipe|1f1b, "
-                             f"got {schedule!r}")
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
+            raise ValueError(f"pipeline.schedule must be gpipe|1f1b|"
+                             f"interleaved, got {schedule!r}")
+        virtual = int(cfg.pipeline.get("virtual_stages", 2))
+        n_chunks = self.pp_size * virtual if schedule == "interleaved" \
+            else self.pp_size
         embed_fn, stage_fn, loss_fn, split_params, merge_params = \
-            self.model.pipeline_fns(self.pp_size)
+            self.model.pipeline_fns(n_chunks)
 
         def step_fn(state: TrainState, batch):
             scale = state.loss_scale.scale if cfg.fp16.enabled else jnp.float32(1.0)
@@ -781,6 +831,16 @@ class Engine:
                 loss, g_sh, g_st = onef1b_spmd_grads(
                     self.mesh, shared, stage_params, mbs, scale,
                     embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                    stage_params_layer_dim_spec=P("pp"))
+                grads = merge_params(g_sh, g_st)
+            elif schedule == "interleaved":
+                # Megatron virtual stages, executed (schedule math:
+                # parallel/schedule.py InterleavedTrainSchedule)
+                shared, stage_params = split_params(state.params)
+                loss, g_sh, g_st = interleaved_spmd_grads(
+                    self.mesh, shared, stage_params, mbs, scale,
+                    embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                    virtual_stages=virtual,
                     stage_params_layer_dim_spec=P("pp"))
                 grads = merge_params(g_sh, g_st)
             else:

@@ -107,3 +107,24 @@ def test_optimizer_state_swapper(tmp_path):
     sw.swap_in("adam/exp_avg/0", restored)
     sw.aio.wait_all()
     np.testing.assert_array_equal(restored, state)
+
+
+def test_cpu_adam_step_slice_matches_full_step():
+    """Leaf-streamed slice updates reproduce the monolithic step exactly
+    (same bias correction across slices of one begin_step)."""
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+
+    rng = np.random.default_rng(0)
+    n = 10_000
+    p_full = rng.normal(size=n).astype(np.float32)
+    p_sliced = p_full.copy()
+    opt_a = DeepSpeedCPUAdam(n, lr=1e-2, weight_decay=0.01)
+    opt_b = DeepSpeedCPUAdam(n, lr=1e-2, weight_decay=0.01)
+    for step in range(3):
+        g = rng.normal(size=n).astype(np.float32)
+        opt_a.step(p_full, g)
+        opt_b.begin_step()
+        for lo, hi in [(0, 1000), (1000, 4096), (4096, n)]:
+            opt_b.step_slice(p_sliced, g[lo:hi], offset=lo)
+    np.testing.assert_allclose(p_sliced, p_full, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(opt_b.exp_avg, opt_a.exp_avg, rtol=1e-6)

@@ -191,3 +191,59 @@ def test_w8_bert_encoder_forward():
     b = np.asarray(jax.tree_util.tree_leaves(out_q8)[0], np.float32)
     rel = np.linalg.norm(a - b) / max(np.linalg.norm(a), 1e-6)
     assert rel < 0.05, rel
+
+
+def test_moe_expert_int8_serving():
+    """MoE expert FFNs (wi/wo) join the int8 path; gate stays fp."""
+    from deepspeed_tpu.parallel.moe import MoEConfig
+
+    cfg = gpt2_config("gpt2-tiny", scan_layers=True,
+                      moe=MoEConfig(num_experts=2, top_k=1,
+                                    capacity_factor=2.0))
+    model = GPT2LMHeadModel(cfg)
+    params = _tiny_params(model, cfg)
+
+    eng_fp = deepspeed_tpu.init_inference(
+        model=GPT2LMHeadModel(cfg), params=params)
+    mesh_mod.set_mesh(None)
+    eng_q8 = deepspeed_tpu.init_inference(
+        model=GPT2LMHeadModel(cfg), params=params,
+        config={"quant": {"enabled": True, "bits": 8}})
+
+    leaves = dict(jax.tree_util.tree_leaves_with_path(eng_q8.params))
+    paths = [jax.tree_util.keystr(p) for p in leaves]
+    assert any(p.endswith("wi_q']") for p in paths), paths[:5]
+    assert any(p.endswith("wo_q']") for p in paths)
+    assert not any(p.endswith("'wi']") or p.endswith("'wo']")
+                   for p in paths)
+    assert any(p.endswith("'wg']") for p in paths)   # gate full width
+
+    ids = np.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(1, 16)), np.int32)
+    a = np.asarray(jax.device_get(eng_fp(ids)), np.float32)
+    b = np.asarray(jax.device_get(eng_q8(ids)), np.float32)
+    rel = np.linalg.norm(a - b) / max(np.linalg.norm(a), 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_gptneox_moe_int8_serving():
+    """NeoX MoE + int8: expert leaves quantize and the module consumes
+    them (regression: MoELayer must receive the family's w8 flag)."""
+    from deepspeed_tpu.models.gptneox import (GPTNeoXForCausalLM,
+                                              gptneox_config)
+    from deepspeed_tpu.parallel.moe import MoEConfig
+
+    cfg = gptneox_config(moe=MoEConfig(num_experts=2, top_k=1,
+                                       capacity_factor=2.0))
+    model = GPTNeoXForCausalLM(cfg)
+    params = _tiny_params(model, cfg)
+    eng = deepspeed_tpu.init_inference(
+        model=GPTNeoXForCausalLM(cfg), params=params,
+        config={"quant": {"enabled": True, "bits": 8}})
+    paths = [jax.tree_util.keystr(p) for p, _ in
+             jax.tree_util.tree_leaves_with_path(eng.params)]
+    assert any(p.endswith("wi_q']") for p in paths)
+    ids = np.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(1, 12)), np.int32)
+    out = eng.generate(ids, max_new_tokens=4)
+    assert out.shape == (1, 16)
