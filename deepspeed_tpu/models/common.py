@@ -21,11 +21,13 @@ Logical axis vocabulary used by every model in the zoo:
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 # Mapping logical axis name -> mesh axis (or tuple), per parallelism style.
@@ -75,19 +77,117 @@ def cross_entropy_loss(
     return nll.sum() / count
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_ce(vocab_size: int, padded_vocab_size: int, ignore_index: int,
+              save_logits: bool):
+    """Build the custom-vjp chunked cross-entropy core (cached per config).
+
+    Forward scans token chunks: each chunk's ``(C, V)`` fp32 logits exist
+    only inside its scan step (matmul → logsumexp → gather, fused by XLA);
+    the residuals are O(N) scalars-per-token (logz), never O(N·V).  The
+    backward pass either recomputes chunk logits (``save_logits=False``,
+    +1 head matmul of FLOPs, zero O(N·V) residency — the 1.5B regime where
+    the head is ~5% of FLOPs and HBM is the binding constraint) or replays
+    bf16 logits saved in forward (``save_logits=True``, zero extra FLOPs —
+    the 125M regime where the head is ~30% of FLOPs).  Either way the fp32
+    ``(N, V)`` cotangent of the stock autodiff path — the exact 1.6 GB
+    margin that OOMs GPT-2-1.5B at micro=4 on a 16 GB chip — is never
+    materialized: d_logits is built and consumed chunk-local.
+    """
+    Vp = padded_vocab_size
+    padded = padded_vocab_size != vocab_size
+
+    def _chunk_stats(hc, wteT, tc):
+        """(C, E) × (E, Vp) → per-token logz/label-logit, fp32 math."""
+        logits = jnp.dot(hc, wteT, preferred_element_type=jnp.float32)
+        if padded:
+            mask = jnp.arange(Vp) < vocab_size
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        valid = tc != ignore_index
+        safe = jnp.where(valid, tc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        lbl = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        return logits, logz, jnp.where(valid, logz - lbl, 0.0)
+
+    @jax.custom_vjp
+    def ce(hf, wteT, tf):
+        def body(acc, xs):
+            hc, tc = xs
+            _, _, nll = _chunk_stats(hc, wteT, tc)
+            return acc + nll.sum(), None
+
+        nll_sum, _ = jax.lax.scan(body, jnp.float32(0.0), (hf, tf))
+        return nll_sum
+
+    def ce_fwd(hf, wteT, tf):
+        def body(acc, xs):
+            hc, tc = xs
+            logits, logz, nll = _chunk_stats(hc, wteT, tc)
+            saved = logits.astype(hf.dtype) if save_logits else jnp.zeros(
+                (), hf.dtype)
+            return acc + nll.sum(), (logz, saved)
+
+        nll_sum, (logzs, saved) = jax.lax.scan(
+            body, jnp.float32(0.0), (hf, tf))
+        return nll_sum, (hf, wteT, tf, logzs, saved)
+
+    def ce_bwd(res, g):
+        hf, wteT, tf, logzs, saved = res
+        K, C, E = hf.shape
+
+        def body(dwteT, xs):
+            hc, tc, logz, sv = xs
+            if save_logits:
+                logits = sv.astype(jnp.float32)
+                if padded:
+                    mask = jnp.arange(Vp) < vocab_size
+                    logits = jnp.where(mask, logits,
+                                       jnp.finfo(jnp.float32).min)
+            else:
+                logits = jnp.dot(hc, wteT,
+                                 preferred_element_type=jnp.float32)
+                if padded:
+                    mask = jnp.arange(Vp) < vocab_size
+                    logits = jnp.where(mask, logits,
+                                       jnp.finfo(jnp.float32).min)
+            valid = tc != ignore_index
+            safe = jnp.where(valid, tc, 0)
+            coeff = (g * valid).astype(jnp.float32)          # (C,)
+            p = jnp.exp(logits - logz[:, None])              # softmax rows
+            onehot = (jnp.arange(Vp)[None, :] == safe[:, None])
+            dlog = (p - onehot) * coeff[:, None]             # (C, Vp) fp32
+            dlogb = dlog.astype(hc.dtype)
+            # d h_c = dlog @ wteT^T ; d wteT += h_c^T @ dlog (fp32 accum)
+            dh_c = jax.lax.dot_general(
+                dlogb, wteT, (((1,), (1,)), ((), ())))       # (C, E)
+            dwteT = dwteT + jnp.dot(hc.T, dlogb,
+                                    preferred_element_type=jnp.float32)
+            return dwteT, dh_c.astype(hc.dtype)
+
+        dwteT, dhs = jax.lax.scan(
+            body, jnp.zeros((E, Vp), jnp.float32),
+            (hf, tf, logzs, saved))
+        return dhs, dwteT.astype(wteT.dtype), \
+            np.zeros(tf.shape, jax.dtypes.float0)
+
+    ce.defvjp(ce_fwd, ce_bwd)
+    return ce
+
+
 def chunked_lm_loss(h: jax.Array, wte: jax.Array, labels: jax.Array, *,
                     vocab_size: int, padded_vocab_size: int, chunk: int,
-                    dtype, ignore_index: int = -100) -> jax.Array:
-    """Tied-head cross-entropy WITHOUT materializing the (B, S, V) logits.
-
-    At 50k vocab the fp32 logits (plus their cotangent) dominate a large
-    micro-batch's live memory (~1.6 GB at B=4, S=1024 — the exact margin
-    that OOMs GPT-2-1.5B at micro=4 on a 16 GB chip).  Token rows are
-    processed in ``chunk``-sized groups under ``jax.checkpoint`` inside a
-    ``lax.map``: each group's logits exist only inside its step, forward
-    and backward.  Exact same loss as the dense path (fp32 logsumexp)."""
+                    dtype, ignore_index: int = -100,
+                    save_logits: bool = False) -> jax.Array:
+    """Tied-head cross-entropy WITHOUT materializing the (B, S, V) fp32
+    logits or their cotangent (see :func:`_fused_ce`).  Exact same loss as
+    the dense path (fp32 logsumexp); ``chunk >= B·S`` degenerates to one
+    full-width chunk, which keeps the single big MXU matmul but still
+    skips the O(N·V) fp32 residency (the round-2 ``lax.map`` version
+    serialized 512-row matmuls and LOST 17% e2e — this one is
+    measurement-driven: big chunks, custom vjp, no per-chunk remat)."""
     B, S, E = h.shape
     N = B * S
+    chunk = min(chunk, N)
     hf = h.reshape(N, E)
     tf = labels.reshape(N)
     pad = (-N) % chunk
@@ -98,22 +198,11 @@ def chunked_lm_loss(h: jax.Array, wte: jax.Array, labels: jax.Array, *,
     hf = hf.reshape(-1, chunk, E)
     tf = tf.reshape(-1, chunk)
     wteT = wte.astype(dtype).T        # (E, V)
-
-    @jax.checkpoint
-    def chunk_nll(hc, tc):
-        logits = jnp.dot(hc, wteT).astype(jnp.float32)       # (chunk, V)
-        if padded_vocab_size != vocab_size:
-            mask = jnp.arange(padded_vocab_size) < vocab_size
-            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-        valid = tc != ignore_index
-        safe = jnp.where(valid, tc, 0)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        lbl = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
-        nll = jnp.where(valid, logz - lbl, 0.0)
-        return nll.sum(), valid.sum()
-
-    sums, counts = jax.lax.map(lambda ab: chunk_nll(*ab), (hf, tf))
-    return sums.sum() / jnp.maximum(counts.sum(), 1)
+    ce = _fused_ce(vocab_size, padded_vocab_size, ignore_index,
+                   bool(save_logits))
+    nll_sum = ce(hf, wteT, tf)
+    count = (tf != ignore_index).sum()
+    return nll_sum / jnp.maximum(count, 1)
 
 
 def shift_labels(input_ids: jax.Array, pad_id: int = -100) -> jax.Array:

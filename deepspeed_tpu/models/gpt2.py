@@ -69,6 +69,11 @@ class GPT2Config:
     # chunk; None = dense logits.  Saves the (B,S,V) fp32 logits+cotangent
     # at large micro sizes; the model output then carries no "logits".
     loss_chunk: Optional[int] = None
+    # chunked head backward: replay bf16 logits saved in forward (True;
+    # zero extra FLOPs — small models where the head dominates) vs
+    # recompute them (False; zero O(N·V) residency — large models where
+    # HBM is the binding constraint).  See models/common.py _fused_ce.
+    loss_save_logits: bool = False
 
     @property
     def padded_vocab_size(self) -> int:
@@ -387,7 +392,8 @@ class GPT2LMHeadModel(nn.Module):
             loss = chunked_lm_loss(
                 h, wte, tgt, vocab_size=cfg.vocab_size,
                 padded_vocab_size=cfg.padded_vocab_size,
-                chunk=cfg.loss_chunk, dtype=cfg.dtype)
+                chunk=cfg.loss_chunk, dtype=cfg.dtype,
+                save_logits=cfg.loss_save_logits)
             out = ModelOutput(loss=loss)
             if cfg.moe is not None:
                 out["aux_loss"] = aux_loss

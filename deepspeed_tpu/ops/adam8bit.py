@@ -67,6 +67,19 @@ class Adam8bitState(NamedTuple):
     scales: Any         # {"m": (...,1), "r": (...,1)} per leaf — replicated
 
 
+def _leaf_moments(g, mc, rc, sc, *, b1, b2, c1, c2, eps):
+    """THE adam8bit per-leaf math (single source for the optax chain and
+    the fused path's fallback): dequant → m/v update → bias-corrected
+    Adam direction → requant."""
+    m = b1 * (mc.astype(jnp.float32) * sc["m"]) + (1.0 - b1) * g
+    r0 = rc.astype(jnp.float32) * sc["r"]
+    v = b2 * (r0 * r0) + (1.0 - b2) * (g * g)
+    upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+    mc2, ms = _quant_sym(m)
+    rc2, rs = _quant_pos(jnp.sqrt(v))
+    return upd, mc2, rc2, {"m": ms, "r": rs}
+
+
 def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
                       eps: float = 1e-8) -> optax.GradientTransformation:
     def init_fn(params):
@@ -91,15 +104,8 @@ def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
         c2 = 1.0 - b2 ** count.astype(jnp.float32)
 
         def leaf(g, mc, rc, sc):
-            g = g.astype(jnp.float32)
-            m = mc.astype(jnp.float32) * sc["m"]
-            r = rc.astype(jnp.float32) * sc["r"]
-            m = b1 * m + (1.0 - b1) * g
-            v = b2 * (r * r) + (1.0 - b2) * (g * g)
-            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
-            mc, ms = _quant_sym(m)
-            rc, rs = _quant_pos(jnp.sqrt(v))
-            return upd, mc, rc, {"m": ms, "r": rs}
+            return _leaf_moments(g.astype(jnp.float32), mc, rc, sc,
+                                 b1=b1, b2=b2, c1=c1, c2=c2, eps=eps)
 
         # scales sit one level deeper than params; tree_map's
         # flatten_up_to treats each {"m","r"} dict as the leaf for its path
@@ -129,3 +135,99 @@ def adamw_8bit(learning_rate: ScalarOrSchedule, b1: float = 0.9,
         parts.append(optax.add_decayed_weights(weight_decay, mask=mask))
     parts.append(optax.scale_by_learning_rate(learning_rate))
     return optax.chain(*parts)
+
+
+# ----------------------------------------------------------------------
+# Fused single-pass update (ops/pallas/adam8bit_kernel.py)
+# ----------------------------------------------------------------------
+def _find_state(opt_state) -> Adam8bitState:
+    if isinstance(opt_state, Adam8bitState):
+        return opt_state
+    if isinstance(opt_state, tuple):
+        for s in opt_state:
+            found = _find_state(s)
+            if found is not None:
+                return found
+    return None
+
+
+def _advance_state(opt_state, new8: Adam8bitState):
+    """Rebuild the optax chain state around a stepped Adam8bitState.
+
+    ``ScaleByScheduleState`` counters advance too, so the fused path and
+    the stock ``tx.update`` path stay interchangeable (same checkpoint
+    layout, same LR-schedule step)."""
+    import optax._src.transform as _T
+
+    if isinstance(opt_state, Adam8bitState):
+        return new8
+    if isinstance(opt_state, _T.ScaleByScheduleState):
+        return _T.ScaleByScheduleState(
+            count=optax.safe_int32_increment(opt_state.count))
+    if isinstance(opt_state, tuple):
+        parts = [_advance_state(s, new8) for s in opt_state]
+        if hasattr(opt_state, "_fields"):      # NamedTuple state
+            return type(opt_state)(*parts)
+        return tuple(parts)
+    return opt_state
+
+
+def fused_apply_factory(*, learning_rate: ScalarOrSchedule, b1: float,
+                        b2: float, eps: float, weight_decay: float = 0.0,
+                        l2: float = 0.0, clip: float = 0.0):
+    """Build ``apply(grads, params, opt_state, grad_norm) →
+    (new_params, new_opt_state)`` — the one-HBM-pass equivalent of the
+    build_tx chain ``clip → [L2] → adam8bit moments → [AdamW decay] → lr``
+    for the ``adamw8bit`` family.  ``opt_state`` is the UNCHANGED optax
+    chain state (checkpoints stay compatible); this just bypasses its
+    fp32-temporary round trips.  Single-device only — the caller guards
+    (multi-device meshes keep the pjit-partitioned unfused math)."""
+    from .attention import on_tpu
+    from .pallas.adam8bit_kernel import apply_fused_leaf, fused_leaf_supported
+
+    def apply(grads, params, opt_state, grad_norm):
+        interp = not on_tpu()
+        st = _find_state(opt_state)
+        if st is None:
+            raise ValueError("no Adam8bitState found in opt_state; "
+                             "fused adam8bit needs the adamw8bit chain")
+        count = optax.safe_int32_increment(st.count)
+        cf = count.astype(jnp.float32)
+        c1 = 1.0 - b1 ** cf
+        c2 = 1.0 - b2 ** cf
+        lr = learning_rate(st.count) if callable(learning_rate) \
+            else jnp.float32(learning_rate)
+        gscale = jnp.float32(1.0)
+        if clip and clip > 0:
+            gscale = jnp.where(grad_norm < clip, 1.0, clip / grad_norm)
+        scalars = jnp.stack([gscale, jnp.asarray(lr, jnp.float32),
+                             c1, c2]).astype(jnp.float32)
+
+        def leaf(g, p, mc, rc, sc):
+            if fused_leaf_supported(p.shape):
+                return apply_fused_leaf(
+                    g, p, mc, rc, sc, scalars, b1=b1, b2=b2, eps=eps,
+                    wd=weight_decay, l2=l2, interpret=interp)
+            # scalar / oversize-row leaves: unfused math, identical result
+            g = g.astype(jnp.float32) * gscale
+            if l2:
+                g = g + l2 * p
+            upd, mc2, rc2, sc2 = _leaf_moments(
+                g, mc, rc, sc, b1=b1, b2=b2, c1=c1, c2=c2, eps=eps)
+            if weight_decay:
+                upd = upd + weight_decay * p
+            return p - lr * upd, mc2, rc2, sc2
+
+        out = jax.tree_util.tree_map(leaf, grads, params, st.m_codes,
+                                     st.r_codes, st.scales)
+        treedef = jax.tree_util.tree_structure(params)
+        new_p, m_codes, r_codes, scales_t = jax.tree_util.tree_transpose(
+            treedef, jax.tree_util.tree_structure((0, 0, 0, {"m": 0, "r": 0})),
+            out)
+        scales = jax.tree_util.tree_map(
+            lambda m, r: {"m": m, "r": r}, scales_t["m"], scales_t["r"])
+        new8 = Adam8bitState(count=count, m_codes=m_codes, r_codes=r_codes,
+                             scales=scales)
+        return new_p, _advance_state(opt_state, new8)
+
+    return apply

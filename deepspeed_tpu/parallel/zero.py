@@ -179,13 +179,33 @@ def opt_state_specs(optimizer, abstract_params, param_like_specs):
         if not any(isinstance(t, Adam8bitState)
                    for t in subtrees(abstract_opt)):
             raise
-        shape_to_spec = {}
-        spec_leaves = jax.tree_util.tree_leaves(
-            param_like_specs, is_leaf=lambda x: isinstance(x, P))
-        for pl, sl in zip(jax.tree_util.tree_leaves(unboxed), spec_leaves):
-            shape_to_spec.setdefault(pl.shape, sl)
-        return jax.tree_util.tree_map(
-            lambda l: shape_to_spec.get(l.shape, P()), abstract_opt)
+        # structure-match param-shaped subtrees against the param tree
+        # (NOT by leaf shape: two same-shaped params with different specs
+        # would silently share the first param's spec)
+        pstruct = jax.tree_util.tree_structure(unboxed)
+
+        def walk(node):
+            if isinstance(node, Adam8bitState):
+                return Adam8bitState(
+                    count=P(),
+                    m_codes=param_like_specs,
+                    r_codes=param_like_specs,
+                    # (…, 1) row scales replicate (can't inherit a
+                    # row-sharded spec on their squeezed dim)
+                    scales=jax.tree_util.tree_map(lambda _: P(),
+                                                  node.scales))
+            try:
+                if jax.tree_util.tree_structure(node) == pstruct:
+                    return param_like_specs
+            except (ValueError, TypeError):
+                pass
+            if isinstance(node, tuple):
+                parts = [walk(c) for c in node]
+                return type(node)(*parts) if hasattr(node, "_fields") \
+                    else tuple(parts)
+            return jax.tree_util.tree_map(lambda _: P(), node)
+
+        return walk(abstract_opt)
 
 
 def named_shardings(mesh, spec_tree):
@@ -357,8 +377,14 @@ class GatheredParameters:
         if self._engine is not None:
             import dataclasses as _dc
 
+            stored = resharded
+            if getattr(self._engine, "_interleave", None) is not None:
+                # the context works in canonical (global) layer order —
+                # engine storage is local-slot order (interleaved-1F1B)
+                stored = self._engine._permute_params(
+                    stored, self._engine._interleave[0])
             self._engine._state = _dc.replace(self._engine._state,
-                                              params=resharded)
+                                              params=stored)
         return False
 
 

@@ -146,7 +146,8 @@ class AsyncCheckpointManager:
         os.makedirs(ckpt_dir, exist_ok=True)
         state_path = os.path.join(ckpt_dir, MODULE_DIR)
         self._ckptr.save(state_path,
-                         args=ocp.args.StandardSave(self.engine.state),
+                         args=ocp.args.StandardSave(
+                             self.engine.canonical_state()),
                          force=True)
         # snapshot the counters NOW — by commit time the engine has moved on
         self._pending = (ckpt_dir, tag, _build_meta(self.engine, client_state))

@@ -242,6 +242,11 @@ class Config:
     # multi-slice spec: which mesh axes span the DCN between slices
     # (``mesh: {"dcn": {"dp": n_slices}, ...}``); see comm.mesh.build_mesh
     mesh_dcn: Optional[dict] = None
+    # reference data_types.grad_accum_dtype: dtype gradients are produced
+    # and accumulated in.  "fp32" (default) = full-precision grads;
+    # "bf16" halves gradient HBM traffic/residency (grads are cast to
+    # fp32 inside the optimizer update either way — fp32 master weights)
+    grad_accum_dtype: str = "fp32"
     # model-config overrides applied by the engine at init (autotuner
     # output: kernel knobs like fused_mlp); also records `autotuned`
     model_overrides: dict = dataclasses.field(default_factory=dict)
@@ -326,7 +331,7 @@ class Config:
 
     # ------------------------------------------------------------------
     _KNOWN_UNSUPPORTED = {
-        "amp", "zero_allow_untested_optimizer", "checkpoint", "data_types",
+        "amp", "zero_allow_untested_optimizer", "checkpoint",
         "comms_logger", "compression_training",
     }
 
@@ -358,6 +363,9 @@ class Config:
             mesh=MeshConfig.from_dict({
                 k: v for k, v in mesh_d.items() if k != "dcn"}),
             mesh_dcn=mesh_d.get("dcn"),
+            grad_accum_dtype=str(
+                (_take(d, "data_types", {}) or {}).get(
+                    "grad_accum_dtype", "fp32")).lower(),
             model_overrides=dict(_take(d, "model_overrides", {}) or {}),
             autotuned=dict(_take(d, "autotuned", {}) or {}),
             wall_clock_breakdown=bool(_take(d, C.WALL_CLOCK_BREAKDOWN, False)),
@@ -383,6 +391,15 @@ class Config:
             cfg.bf16 = BFloat16Config(enabled=False)
         if cfg.fp16.enabled and cfg.bf16.enabled:
             raise ConfigError("fp16 and bf16 cannot both be enabled")
+        if cfg.grad_accum_dtype not in ("fp32", "float32", "bf16",
+                                        "bfloat16"):
+            raise ConfigError(
+                f"data_types.grad_accum_dtype {cfg.grad_accum_dtype!r}: "
+                "valid values are fp32|bf16")
+        if cfg.grad_accum_dtype in ("bf16", "bfloat16") and cfg.fp16.enabled:
+            raise ConfigError(
+                "data_types.grad_accum_dtype=bf16 requires bf16 training "
+                "(fp16 loss scaling needs fp32 gradient accumulation)")
         known_keys = {
             C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
             C.GRADIENT_ACCUMULATION_STEPS, C.STEPS_PER_PRINT, C.GRADIENT_CLIPPING,
@@ -394,7 +411,7 @@ class Config:
             C.SPARSE_GRADIENT_MODULES, C.PIPELINE,
             C.CURRICULUM_LEARNING, C.PROGRESSIVE_LAYER_DROP, C.EIGENVALUE,
             C.QUANTIZE_TRAINING, C.FLOPS_PROFILER, C.ELASTICITY, C.AUTOTUNING,
-            C.SPARSE_ATTENTION, "model_overrides", "autotuned",
+            C.SPARSE_ATTENTION, "model_overrides", "autotuned", "data_types",
         }
         for key in d:
             if key not in known_keys:

@@ -173,6 +173,35 @@ class Engine:
         else:
             self.tx = build_tx(self.config, learning_rate=self.lr_scheduler)
         self.optimizer = self.tx  # returned from deepspeed_tpu.initialize
+        # Fused adam8bit: one Pallas HBM pass per leaf instead of the
+        # XLA chain's fp32 moment round trips (the round-2 measured
+        # optimizer bottleneck at 1.5B).  Same opt_state layout — the
+        # fused apply bypasses tx.update, it does not replace tx.
+        # Single-device only: pjit partitions the unfused math on meshes.
+        self._fused_opt = None
+        from . import constants as _C
+
+        ocfg = self.config.optimizer
+        if (optimizer is None and self.offload_device == "none"
+                and self.n_devices == 1
+                and ocfg.type in (_C.ADAM8BIT_OPTIMIZER,
+                                  _C.ADAMW8BIT_OPTIMIZER)
+                # opt-in: measured 42 ms vs XLA's 28 ms on a 0.57B tree
+                # (the one-pass kernel loses to XLA's own fusion; see
+                # BENCH_NORTHSTAR.md round-3 notes) — kept for the
+                # multi-pass-regression guard it provides and further
+                # tuning, not as the default path
+                and ocfg.extra.get("fused", False)):
+            from ..ops.adam8bit import fused_apply_factory
+
+            decoupled = ocfg.type == _C.ADAMW8BIT_OPTIMIZER or \
+                ocfg.extra.get("adam_w_mode", False)
+            b1, b2 = ocfg.betas
+            self._fused_opt = fused_apply_factory(
+                learning_rate=self.lr_scheduler, b1=b1, b2=b2, eps=ocfg.eps,
+                weight_decay=ocfg.weight_decay if decoupled else 0.0,
+                l2=0.0 if decoupled else ocfg.weight_decay,
+                clip=self.config.gradient_clipping or 0.0)
 
         # ---- loss fn -------------------------------------------------
         self._user_loss_fn = loss_fn
@@ -226,6 +255,31 @@ class Engine:
             self.quantizer = Quantizer(
                 QuantizeConfig.from_dict(self.config.quantize_training))
 
+        if self.config.grad_accum_dtype in ("bf16", "bfloat16"):
+            if self.config.sparse_gradients:
+                raise NotImplementedError(
+                    "data_types.grad_accum_dtype=bf16 + sparse_gradients: "
+                    "the packed sparse reduction runs on fp32 grads")
+            if self.pp_size > 1:
+                raise NotImplementedError(
+                    "data_types.grad_accum_dtype=bf16 is not threaded "
+                    "through the pipeline clock loops yet (grads there are "
+                    "fp32); drop the setting or use pp=1")
+
+        # Interleaved-1F1B stores the stacked layer dim PRE-PERMUTED in
+        # local-slot order (permuted once at init; inverse-permuted on
+        # checkpoint save / the ``params`` property), so the per-step
+        # all-to-all of the whole parameter tree disappears (round-2
+        # verdict item 3; Megatron static placement,
+        # reference runtime/pipe/module.py:363).
+        self._interleave = None
+        if self.pp_size > 1 and \
+                self.config.pipeline.get("schedule") == "interleaved":
+            from ..parallel.pipeline import interleaved_perm
+
+            V = int(self.config.pipeline.get("virtual_stages", 2))
+            self._interleave = interleaved_perm(self.pp_size, V)
+
         if model_parameters is not None:
             self.init_params(params=model_parameters)
 
@@ -263,12 +317,88 @@ class Engine:
     @property
     def params(self):
         self._require_state()
+        if self._interleave is not None:
+            return self._permute_params(self._state.params,
+                                        self._interleave[1])
         return self._state.params
 
     @property
     def state(self) -> TrainState:
         self._require_state()
         return self._state
+
+    def canonical_state(self) -> "TrainState":
+        """TrainState with the layer stack in canonical (global) order —
+        what checkpoints must contain.  Identical to ``state`` except
+        under interleaved-1F1B, whose storage is local-slot permuted."""
+        self._require_state()
+        if self._interleave is None:
+            return self._state
+        return self._permute_train_state(self._state, self._interleave[1])
+
+    # ---- interleaved-1F1B local-slot layout helpers ------------------
+    @functools.cached_property
+    def _pipe_split_merge(self):
+        cfg = self.config
+        virtual = int(cfg.pipeline.get("virtual_stages", 2))
+        n_chunks = self.pp_size * virtual \
+            if cfg.pipeline.get("schedule") == "interleaved" else self.pp_size
+        fns = self.model.pipeline_fns(n_chunks)
+        return fns[3], fns[4]          # (split_params, merge_params)
+
+    def _permute_params(self, params, order):
+        """Reorder the stacked layer dim of the stage stack (chunk units);
+        shared (embed/head) leaves pass through."""
+        from ..parallel.pipeline import permute_stacked_tree
+
+        split, merge = self._pipe_split_merge
+        shared, stage = split(params)
+        return merge(shared, permute_stacked_tree(stage, order))
+
+    def _permute_opt_state(self, opt_state, flags, order):
+        """Apply the stack permutation to every param-shaped subtree of
+        the optax state (Adam mu/nu, int8 codes, per-row scales …)."""
+        from ..ops.adam8bit import Adam8bitState
+        from ..parallel.pipeline import permute_stacked_tree
+
+        pstruct = jax.tree_util.tree_structure(flags)
+
+        def permute_if(f, leaf):
+            return permute_stacked_tree(leaf, order) if f else leaf
+
+        def walk(node):
+            if isinstance(node, Adam8bitState):
+                return Adam8bitState(
+                    count=node.count,
+                    m_codes=jax.tree_util.tree_map(
+                        permute_if, flags, node.m_codes),
+                    r_codes=jax.tree_util.tree_map(
+                        permute_if, flags, node.r_codes),
+                    scales=jax.tree_util.tree_map(
+                        lambda f, sub: {k: permute_if(f, v)
+                                        for k, v in sub.items()},
+                        flags, node.scales))
+            try:
+                if jax.tree_util.tree_structure(node) == pstruct:
+                    return jax.tree_util.tree_map(permute_if, flags, node)
+            except (ValueError, TypeError):
+                pass
+            if isinstance(node, tuple):
+                parts = [walk(c) for c in node]
+                return type(node)(*parts) if hasattr(node, "_fields") \
+                    else tuple(parts)
+            return node
+
+        return walk(opt_state)
+
+    def _permute_train_state(self, state: "TrainState", order):
+        split, merge = self._pipe_split_merge
+        shared, stage = split(state.params)
+        flags = merge(jax.tree_util.tree_map(lambda _: False, shared),
+                      jax.tree_util.tree_map(lambda _: True, stage))
+        return state.replace(
+            params=self._permute_params(state.params, order),
+            opt_state=self._permute_opt_state(state.opt_state, flags, order))
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return self.micro_steps % self.gradient_accumulation_steps == 0
@@ -386,6 +516,13 @@ class Engine:
                 return _unbox(self.model.init(r, **fake)["params"])
             placed = jax.jit(_init_unboxed, out_shardings=param_sh)(rng)
 
+        if self._interleave is not None:
+            # one-time all-to-all into local-slot order; opt state below
+            # is born in the same layout (tx.init of permuted params)
+            placed = jax.jit(
+                functools.partial(self._permute_params,
+                                  order=self._interleave[0]),
+                out_shardings=param_sh)(placed)
         opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(placed)
         ls_state = precision.init_loss_scale(self.config.fp16)
         ls_state = jax.device_put(ls_state, repl)
@@ -465,8 +602,19 @@ class Engine:
     # ------------------------------------------------------------------
     # compiled pieces
     # ------------------------------------------------------------------
+    @property
+    def _grad_dtype(self):
+        """bf16 when ``data_types.grad_accum_dtype`` asks for it: grads
+        are produced (cotangents of the bf16-cast params) and accumulated
+        in bf16, halving gradient HBM traffic — the reference's
+        grad_accum_dtype semantics.  fp32 master weights are unaffected
+        (``_apply_grads`` casts up before the update)."""
+        if self.config.grad_accum_dtype in ("bf16", "bfloat16"):
+            return jnp.bfloat16
+        return None
+
     def _grads_of(self, params, batch, rng, scale, pld_theta=None):
-        """(scaled loss, fp32 grads) on one global micro-batch."""
+        """(scaled loss, grads) on one global micro-batch."""
         if self.config.sparse_gradients:
             return self._grads_of_sparse(params, batch, rng, scale, pld_theta)
 
@@ -475,6 +623,11 @@ class Engine:
                                  pld_theta=pld_theta)
             return loss * scale
 
+        gdt = self._grad_dtype
+        if gdt is not None:
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(gdt)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         loss, grads = jax.value_and_grad(scaled_loss_fn)(params)
         return loss, grads
 
@@ -523,6 +676,21 @@ class Engine:
             def reduce_leaf(path, gl):
                 if gl.ndim == 2 and max_rows is not None \
                         and is_sparse_path(path):
+                    # the packed reduction carries at most max_rows rows;
+                    # a leaf with denser grads (tied embedding, non-gather
+                    # use) would be SILENTLY truncated — detect and warn
+                    # at run time (cost: one row-any reduction per leaf)
+                    name = "/".join(str(getattr(k, "key", k)) for k in path)
+                    nnz = jnp.sum(jnp.any(gl != 0, axis=1))
+                    jax.lax.cond(
+                        nnz > max_rows,
+                        lambda: jax.debug.print(
+                            "deepspeed_tpu sparse_gradients OVERFLOW on "
+                            "leaf " + name + ": {} nonzero grad rows > "
+                            "local token budget {} — rows are being "
+                            "DROPPED; remove this leaf from "
+                            "sparse_gradient_modules", nnz, max_rows),
+                        lambda: None)
                     return sg.sparse_all_reduce(gl, axes, max_rows) / W
                 return jax.lax.pmean(gl, axes)
 
@@ -544,8 +712,13 @@ class Engine:
         inv = 1.0 / (denom * scale)
         grads = jax.tree_util.tree_map(lambda g: (g * inv).astype(jnp.float32), grad_sum)
         grad_norm = optax.global_norm(grads)
-        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if self._fused_opt is not None:
+            new_params, new_opt = self._fused_opt(
+                grads, state.params, state.opt_state, grad_norm)
+        else:
+            updates, new_opt = self.tx.update(grads, state.opt_state,
+                                              state.params)
+            new_params = optax.apply_updates(state.params, updates)
         if self.quantizer is not None:
             # MoQ: fake-quantize weights at the scheduled precision after the
             # update (reference runtime/quantize.py in-place kernel pass)
@@ -604,9 +777,12 @@ class Engine:
         return jax.tree_util.tree_map(split, batch)
 
     @functools.cached_property
-    def _compiled_train_step(self):
+    def _train_step_body(self):
+        """The uncompiled ``(state, batch, *extra) → (state, metrics)``
+        optimizer-step function — jitted alone by
+        :attr:`_compiled_train_step`, scanned by :meth:`train_batches`."""
         if self.pp_size > 1:
-            return self._compiled_pipeline_step
+            return self._pipeline_step_body
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         pld_on = self.progressive_layer_drop is not None
@@ -623,12 +799,14 @@ class Engine:
                     mb_rng = jax.random.fold_in(rng, i)
                     loss, grads = self._grads_of(state.params, mb, mb_rng, scale,
                                                  pld_theta)
-                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(a.dtype), g_acc, grads)
                     g_acc = self._constrain(g_acc, self._grad_specs)
                     return (g_acc, l_acc + loss, i + 1), None
 
+                acc_dt = self._grad_dtype or jnp.float32
                 zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                    lambda p: jnp.zeros(p.shape, acc_dt), state.params)
                 zeros = self._constrain(zeros, self._grad_specs)
                 (g_sum, loss_sum, _), _ = jax.lax.scan(
                     body, (zeros, jnp.float32(0.0), jnp.int32(0)), mbs)
@@ -638,8 +816,116 @@ class Engine:
                 g_sum = self._constrain(g_sum, self._grad_specs)
             return self._apply_grads(state, g_sum, loss_sum, jnp.float32(gas))
 
-        return jax.jit(step_fn, donate_argnums=(0,),
+        return step_fn
+
+    @functools.cached_property
+    def _compiled_train_step(self):
+        return jax.jit(self._train_step_body, donate_argnums=(0,),
                        out_shardings=(self._state_shardings, None))
+
+    def _compiled_multi_step(self, steps: int, stacked: bool):
+        """``steps`` optimizer steps as ONE compiled scan — one host
+        dispatch instead of ``steps`` (each dispatch costs a full host
+        round trip on remote/tunneled devices, ~5 ms measured)."""
+        cache = self.__dict__.setdefault("_multi_step_cache", {})
+        key = (steps, stacked)
+        if key not in cache:
+            body = self._train_step_body
+
+            def multi(state: TrainState, batch):
+                def scan_body(st, mb):
+                    st2, metrics = body(st, mb if stacked else batch)
+                    return st2, metrics["loss"]
+
+                return jax.lax.scan(scan_body, state,
+                                    batch if stacked else None,
+                                    length=steps)
+
+            cache[key] = jax.jit(
+                multi, donate_argnums=(0,),
+                out_shardings=(self._state_shardings, None))
+        return cache[key]
+
+    def train_batches(self, batch, steps: int, stacked: Optional[bool] = None):
+        """Run ``steps`` full optimizer steps in one compiled program.
+
+        The multi-step analog of :meth:`train_batch` (reference semantics:
+        ``steps`` sequential ``train_batch`` calls), with the per-step
+        host dispatch amortized away — the standard JAX training-loop
+        idiom for keeping a remote accelerator saturated.
+
+        ``batch`` leaves carry either leading dim ``train_batch_size``
+        (the same global batch repeats every step — useful for steady-
+        state benchmarking) or a fresh leading ``steps`` axis stacked on
+        top (one global batch per step); pass ``stacked=`` explicitly
+        when ``steps == train_batch_size`` makes that ambiguous.  Returns
+        the per-step loss array (``(steps,)``, device-resident).
+        """
+        self._require_state()
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        unsupported = [
+            ("offload_optimizer", self.offload_device != "none"),
+            ("offload_param", self._param_offload is not None),
+            ("curriculum_learning", self.curriculum_scheduler is not None),
+            ("progressive_layer_drop",
+             self.progressive_layer_drop is not None),
+            # fp16's skipped_steps counter is stepped host-side per step
+            ("fp16", self.config.fp16.enabled),
+        ]
+        bad = [name for name, cond in unsupported if cond]
+        if bad:
+            raise NotImplementedError(
+                f"train_batches does not support {bad}: these features "
+                "step host-side state between optimizer steps — call "
+                "train_batch per step instead")
+        B = self.train_batch_size
+
+        def lead(x):
+            return np.shape(x)[0] if np.ndim(x) else 0
+
+        leads = {lead(l) for l in jax.tree_util.tree_leaves(batch)}
+        if stacked is None:
+            if B == steps and leads == {B}:
+                raise ValueError(
+                    f"steps == train_batch_size == {B}: cannot infer "
+                    "whether the leading dim is the batch or the steps "
+                    "axis — pass stacked=True/False explicitly")
+            stacked = leads == {steps}
+        if not stacked:                       # same batch every step
+            if leads != {B}:
+                raise ValueError(
+                    f"batch leading dims {sorted(leads)} != "
+                    f"train_batch_size {B}")
+            batches = self._shard_batch(batch)
+        else:                                 # one batch per step
+            if leads != {steps}:
+                raise ValueError(
+                    f"stacked batch leading dims {sorted(leads)} != "
+                    f"steps {steps}")
+            sp = self.mesh.shape["sp"]
+
+            def put(x):
+                if np.ndim(x) < 2 or np.shape(x)[1] % self.dp_world != 0:
+                    raise ValueError(
+                        f"stacked batch dim 1 {np.shape(x)} must be the "
+                        f"global batch, divisible by dp world "
+                        f"{self.dp_world}")
+                dims = [None, DATA_AXES] + [None] * (np.ndim(x) - 2)
+                if sp > 1 and np.ndim(x) >= 3 and np.shape(x)[2] % sp == 0:
+                    dims[2] = "sp"
+                return jax.device_put(
+                    jnp.asarray(x), NamedSharding(self.mesh, P(*dims)))
+
+            batches = jax.tree_util.tree_map(put, batch)
+        self._tput.start()
+        self._state, losses = self._compiled_multi_step(steps, stacked)(
+            self._state, batches)
+        self.global_steps += steps
+        self.micro_steps += steps * self.gradient_accumulation_steps
+        self.global_samples += steps * B
+        self._tput.stop(result=losses)
+        return losses
 
     # ------------------------------------------------------------------
     # ZeRO-Offload: host master weights + C++ CPU-Adam (reference
@@ -800,10 +1086,11 @@ class Engine:
         return loss
 
     @functools.cached_property
-    def _compiled_pipeline_step(self):
+    def _pipeline_step_body(self):
         """Train step when mesh pp>1: grad-accumulation micro-batches ARE
         the pipeline micro-batches; the whole GPipe wave is one scan (see
-        ``parallel/pipeline.py``)."""
+        ``parallel/pipeline.py``).  Uncompiled — jitted by
+        :attr:`_compiled_train_step`, scanned by :meth:`train_batches`."""
         from ..parallel.pipeline import (interleaved_spmd_grads,
                                          onef1b_spmd_grads,
                                          pipeline_spmd_loss)
@@ -841,7 +1128,8 @@ class Engine:
                     self.mesh, shared, stage_params, mbs, scale,
                     embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
                     virtual_stages=virtual,
-                    stage_params_layer_dim_spec=P("pp"))
+                    stage_params_layer_dim_spec=P("pp"),
+                    pre_permuted=True)   # state lives in local-slot order
                 grads = merge_params(g_sh, g_st)
             else:
                 def scaled_loss(params):
@@ -856,12 +1144,14 @@ class Engine:
             grads = self._constrain(grads, self._grad_specs)
             return self._apply_grads(state, grads, loss, jnp.float32(1.0))
 
-        return jax.jit(step_fn, donate_argnums=(0,),
-                       out_shardings=(self._state_shardings, None))
+        return step_fn
 
     @functools.cached_property
     def _compiled_eval_step(self):
         def eval_fn(params, batch):
+            if self._interleave is not None:
+                # full-model apply needs global layer order
+                params = self._permute_params(params, self._interleave[1])
             return self._loss_fn(params, batch, None, deterministic=True)
 
         return jax.jit(eval_fn)
@@ -874,7 +1164,13 @@ class Engine:
             rng = jax.random.fold_in(
                 jax.random.fold_in(self._base_rng, state.step), micro_idx)
             scale = state.loss_scale.scale if self.config.fp16.enabled else jnp.float32(1.0)
-            loss, grads = self._grads_of(state.params, batch, rng, scale)
+            params = state.params
+            if self._interleave is not None:
+                params = self._permute_params(params, self._interleave[1])
+            loss, grads = self._grads_of(params, batch, rng, scale)
+            if self._interleave is not None:
+                # back to the stored local-slot layout for apply/step
+                grads = self._permute_params(grads, self._interleave[0])
             grads = self._constrain(grads, self._grad_specs)
             return loss / scale, grads
 
@@ -895,8 +1191,15 @@ class Engine:
     # ------------------------------------------------------------------
     def _shard_batch(self, batch):
         sp = self.mesh.shape["sp"]
+        seen = {}   # aliased leaves (labels=input_ids) transfer once
 
         def put(x):
+            if id(x) in seen:
+                return seen[id(x)]
+            out = seen[id(x)] = _put(x)
+            return out
+
+        def _put(x):
             if np.ndim(x) == 0 or np.shape(x)[0] % self.dp_world != 0:
                 raise ValueError(
                     f"batch leading dim {np.shape(x)} must be divisible by the "
@@ -907,9 +1210,26 @@ class Engine:
             if sp > 1 and np.ndim(x) >= 2 and np.shape(x)[1] % sp == 0:
                 dims[1] = "sp"
             sharding = NamedSharding(self.mesh, P(*dims))
+            # already-placed leaves skip the transfer entirely: a host
+            # round trip per leaf per step is pure overhead (tens of ms
+            # on remote/tunneled devices — measured 27 ms per 98 KB leaf)
+            if isinstance(x, jax.Array) and getattr(x, "sharding", None) \
+                    == sharding and not x.is_deleted():
+                return x
             return jax.device_put(jnp.asarray(x), sharding)
 
         return jax.tree_util.tree_map(put, batch)
+
+    def prepare_batch(self, batch):
+        """Device-prefetch a global batch (public input-pipeline hook).
+
+        Returns the batch as sharded device arrays; passing the result to
+        :meth:`train_batch` (or :meth:`eval_batch`) skips the per-step
+        host→device transfer — the TPU analog of the reference's
+        pin_memory/prefetch dataloader path (``deepspeed_io`` pin_memory,
+        reference ``runtime/dataloader.py``).  Use it to overlap the next
+        batch's transfer with the current step."""
+        return self._shard_batch(batch)
 
     def train_batch(self, batch=None, data_iter=None):
         """One full optimizer step on a global batch (THE fast path).
@@ -1083,11 +1403,32 @@ class Engine:
         from .checkpointing import save_checkpoint as _save
 
         self._require_state()
-        return _save(self, save_dir, tag=tag, client_state=client_state)
+        if self._interleave is None:
+            return _save(self, save_dir, tag=tag, client_state=client_state)
+        # checkpoints stay in canonical (global) layer order so any
+        # topology/schedule can resume them
+        stored = self._state
+        self._state = self._permute_train_state(stored, self._interleave[1])
+        try:
+            return _save(self, save_dir, tag=tag, client_state=client_state)
+        finally:
+            self._state = stored
 
     def load_checkpoint(self, load_dir, tag=None, strict: bool = True):
         if self._param_offload is not None:
             return self._param_offload.load_checkpoint(load_dir, tag=tag)
         from .checkpointing import load_checkpoint as _load
 
-        return _load(self, load_dir, tag=tag, strict=strict)
+        if self._interleave is None or self._state is None:
+            return _load(self, load_dir, tag=tag, strict=strict)
+        stored = self._state
+        self._state = self._permute_train_state(stored, self._interleave[1])
+        try:
+            out = _load(self, load_dir, tag=tag, strict=strict)
+        finally:
+            if self._state is not None:
+                self._state = self._permute_train_state(
+                    self._state, self._interleave[0])
+            else:
+                self._state = stored
+        return out

@@ -70,6 +70,89 @@ def test_adam8bit_state_dtypes_and_memory():
         8 * l.size for l in jax.tree_util.tree_leaves(params))
 
 
+def test_fused_adam8bit_matches_unfused_single_step():
+    """ops/pallas/adam8bit_kernel.py fused apply == the optax chain,
+    bit-exact on one step (clip + decoupled decay included)."""
+    from deepspeed_tpu.ops.adam8bit import _find_state, fused_apply_factory
+
+    rng = np.random.default_rng(1)
+    params = {"a": jnp.asarray(rng.normal(size=(40, 96)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(96,)), jnp.float32)}
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32) * 0.1,
+        params)
+
+    def sched(c):
+        return 1e-3 * (1.0 + c.astype(jnp.float32))
+
+    tx = optax.chain(optax.clip_by_global_norm(0.5),
+                     adamw_8bit(sched, weight_decay=0.1))
+    state = tx.init(params)
+    u, state = tx.update(grads, state, params)     # warm: nonzero moments
+    params = optax.apply_updates(params, u)
+
+    u2, state_ref = tx.update(grads, state, params)
+    p_ref = optax.apply_updates(params, u2)
+    fused = fused_apply_factory(learning_rate=sched, b1=0.9, b2=0.999,
+                                eps=1e-8, weight_decay=0.1, clip=0.5)
+    p_fused, state_fused = jax.jit(fused)(
+        grads, params, state, optax.global_norm(grads))
+
+    # one-ulp FMA/fusion differences between the two compiled programs are
+    # expected; a boundary-straddling round can move a code by one level
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-6, rtol=1e-6),
+        p_ref, p_fused)
+    s_ref, s_f = _find_state(state_ref), _find_state(state_fused)
+    assert int(s_f.count) == int(s_ref.count)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_less(
+            np.abs(np.asarray(a, np.int32) - np.asarray(b, np.int32)), 2),
+        (s_ref.m_codes, s_ref.r_codes), (s_f.m_codes, s_f.r_codes))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5),
+        s_ref.scales, s_f.scales)
+
+
+def test_fused_adam8bit_engine_single_device(tmp_path):
+    """On a 1-device mesh the engine takes the fused path (interpret mode
+    on CPU) and the checkpoint layout stays the stock optax chain state."""
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    mesh = mesh_mod.build_mesh(devices=jax.devices()[:1])
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "adamw8bit",
+                         "params": {"lr": 1e-3, "weight_decay": 0.01,
+                                    "fused": True}},
+           "gradient_clipping": 1.0,
+           "zero_optimization": {"stage": 1}}
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                               mesh=mesh)
+    assert engine._fused_opt is not None
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 32, 512)
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    engine.save_checkpoint(str(tmp_path), tag="fq8")
+    # resume into an engine with the fused path disabled: same state tree
+    mesh_mod.set_mesh(None)
+    cfg2 = {**cfg, "optimizer": {"type": "adamw8bit",
+                                 "params": {"lr": 1e-3, "weight_decay": 0.01,
+                                            "fused": False}}}
+    mesh2 = mesh_mod.build_mesh(devices=jax.devices()[:1])
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True)),
+        config=cfg2, mesh=mesh2)
+    assert engine2._fused_opt is None
+    engine2.init_params()
+    engine2.load_checkpoint(str(tmp_path), tag="fq8")
+    l2 = float(engine2.train_batch(batch))
+    assert np.isfinite(l2) and l2 < losses[0]
+
+
 def test_engine_trains_with_adam8bit_and_checkpoints(tmp_path):
     from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
 

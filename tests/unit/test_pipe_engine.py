@@ -197,3 +197,43 @@ def test_interleaved_matches_gpipe_exactly():
     e_i.init_params()
     l_i = [float(e_i.train_batch(batch)) for _ in range(3)]
     np.testing.assert_allclose(l_i, l_g, rtol=2e-5, atol=1e-6)
+
+
+def test_interleaved_params_pre_permuted_no_step_alltoall(tmp_path):
+    """Round-2 verdict item 3: the interleaved step must not regather the
+    pp-sharded layer stack per step.  The stack is stored in local-slot
+    order (permuted once at init), so the compiled step HLO carries no
+    all-to-all; checkpoints stay canonical (a gpipe engine resumes them)."""
+    gas = 4
+    cfg_i = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "pipeline": {"schedule": "interleaved", "virtual_stages": 2},
+        "mesh": {"pp": 2, "dp": 4},
+    }
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", n_layer=4,
+                                        scan_layers=True))
+    e_i, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg_i)
+    e_i.init_params()
+    batch = token_batch(e_i.train_batch_size, 32, 512, seed=7)
+    l_i = [float(e_i.train_batch(batch)) for _ in range(3)]
+
+    hlo = e_i._compiled_train_step.lower(
+        e_i.state, batch).compile().as_text()
+    assert "all-to-all" not in hlo, \
+        "interleaved step regathers the layer stack per step"
+
+    # user-facing params view is canonical: matches a fresh global-order
+    # init of the same seed/model
+    e_i.save_checkpoint(str(tmp_path), tag="il")
+    mesh_mod.set_mesh(None)
+    model2 = GPT2LMHeadModel(gpt2_config("gpt2-tiny", n_layer=4,
+                                         scan_layers=True))
+    e_g, _, _, _ = deepspeed_tpu.initialize(model=model2, config={
+        **cfg_i, "pipeline": {"schedule": "gpipe"}})
+    e_g.init_params()
+    e_g.load_checkpoint(str(tmp_path), tag="il")
+    l_g = [float(e_g.train_batch(batch)) for _ in range(2)]
+    l_i2 = [float(e_i.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(l_i2, l_g, rtol=2e-5, atol=1e-6)
