@@ -92,6 +92,61 @@ def bench_decode():
         "vs_baseline": None}), flush=True)
 
 
+def bench_serving():
+    """Serving block for the official record (``extra.serving``):
+    p50 TTFT through the ContinuousBatcher + batched decode tokens/s,
+    fp (bf16-from-fp32) vs int8 (``quant: {enabled, bits: 8}``) on the
+    same model.  ``DS_TPU_BENCH_SKIP_SERVING=1`` skips (each variant
+    costs a prefill+decode compile over the tunnel).  Returns the dict.
+    """
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    preset, slots, new_toks, prompt_len = \
+        ("gpt2-760m", 8, 64, 32) if on_tpu else ("gpt2-tiny", 2, 8, 8)
+    rng = np.random.default_rng(0)
+
+    def run_variant(quant: dict):
+        cfg = gpt2_config(preset)
+        model = GPT2LMHeadModel(cfg)
+        params = jax.tree_util.tree_map(
+            lambda x: getattr(x, "value", x),
+            model.init(jax.random.PRNGKey(0),
+                       np.zeros((1, 8), np.int32))["params"],
+            is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                           quant=quant)
+        prompts = [rng.integers(0, cfg.vocab_size,
+                                size=(prompt_len,)).astype(np.int32)
+                   for _ in range(slots * 2)]
+        batcher = ContinuousBatcher(eng, n_slots=slots)
+        ticks = 16 if on_tpu else 4
+        batcher.run(prompts[:slots], max_new_tokens=4, ticks=ticks)  # warm
+        batcher.reset_latency_stats()   # keep compile-time TTFTs out
+        t0 = time.perf_counter()
+        outs = batcher.run(prompts, max_new_tokens=new_toks, ticks=ticks)
+        dt = time.perf_counter() - t0
+        tokens = sum(len(o) - prompt_len for o in outs)
+        lat = batcher.latency_stats()
+        del eng, batcher
+        return {"decode_tok_s": round(tokens / dt, 1),
+                "ttft_p50_ms": round(1000 * lat["ttft_p50_s"], 1),
+                "ttft_p90_ms": round(1000 * lat["ttft_p90_s"], 1)}
+
+    out = {"model": preset, "slots": slots, "new_tokens": new_toks}
+    out["fp"] = run_variant({})
+    out["int8"] = run_variant({"enabled": True, "bits": 8})
+    if out["fp"]["decode_tok_s"]:
+        out["int8_speedup"] = round(
+            out["int8"]["decode_tok_s"] / out["fp"]["decode_tok_s"], 2)
+    return out
+
+
 def bench_northstar(steps: int = 8):
     """GPT-2-1.5B ZeRO-3 on one chip (the BASELINE.json metric).
 
@@ -239,18 +294,27 @@ def bench_train():
             result["extra"]["north_star_1p5b"] = bench_northstar()
         except Exception as e:  # keep the headline record green
             result["extra"]["north_star_1p5b"] = {"error": repr(e)[:300]}
+    if not os.environ.get("DS_TPU_BENCH_SKIP_SERVING"):
+        try:
+            result["extra"]["serving"] = bench_serving()
+        except Exception as e:
+            result["extra"]["serving"] = {"error": repr(e)[:300]}
     print(json.dumps(result), flush=True)
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--mode", choices=["train", "decode", "northstar"],
+    ap.add_argument("--mode",
+                    choices=["train", "decode", "northstar", "serving"],
                     default="train")
     cli, _ = ap.parse_known_args()
     if cli.mode == "decode":
         return bench_decode()
     if cli.mode == "northstar":
         print(json.dumps(bench_northstar()), flush=True)
+        return
+    if cli.mode == "serving":
+        print(json.dumps(bench_serving()), flush=True)
         return
     return bench_train()
 

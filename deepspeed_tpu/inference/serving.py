@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -89,6 +90,16 @@ class ContinuousBatcher:
         self._tick_no = 0
         self._next_uid = 0
         self._finished: Dict[int, np.ndarray] = {}
+        # per-request latency bookkeeping (submit → first token → done),
+        # the serving-metrics surface production schedulers expose; TTFT
+        # here covers queueing + prefill + first sample (reference has no
+        # batcher, so no analog — BASELINE.json names "inference p50 TTFT").
+        # In-flight times live keyed by uid; at retirement they collapse
+        # into a bounded (ttft, e2e) window so a long-lived server's
+        # memory stays O(window), not O(requests served).
+        self._t_submit: Dict[int, float] = {}
+        self._t_first: Dict[int, float] = {}
+        self._lat: deque = deque(maxlen=4096)
 
         decode_model = engine._decode_model
         top_k_static = self.top_k
@@ -206,6 +217,7 @@ class ContinuousBatcher:
         self._next_uid += 1
         self._queue.append(Request(uid, prompt, max_new_tokens,
                                    temperature, top_p, repetition_penalty))
+        self._t_submit[uid] = time.perf_counter()
         return uid
 
     @property
@@ -262,6 +274,7 @@ class ContinuousBatcher:
                 len(req.prompt), req.uid, i,
                 req.temperature, req.top_p, req.repetition_penalty)
             first_host = int(jax.device_get(first)[0])
+            self._t_first[req.uid] = time.perf_counter()
             done0 = first_host == self.eos or req.max_new_tokens <= 1
             self._slots[i] = _Active(req, [first_host])
             if done0:
@@ -271,6 +284,13 @@ class ContinuousBatcher:
         act = self._slots[i]
         self._finished[act.req.uid] = np.concatenate(
             [act.req.prompt, np.asarray(act.emitted, np.int32)])
+        uid = act.req.uid
+        t_sub = self._t_submit.pop(uid, None)
+        t_first = self._t_first.pop(uid, None)
+        if t_sub is not None:
+            now = time.perf_counter()
+            self._lat.append((t_first - t_sub if t_first is not None
+                              else float("nan"), now - t_sub))
         self._slots[i] = None
         self._done, self._pos, self._cache = self._retire_fn(
             self._done, self._pos, self._cache, i)
@@ -319,3 +339,24 @@ class ContinuousBatcher:
         while any(u not in self._finished for u in uids):
             self.step(ticks=ticks)
         return [self._finished[u] for u in uids]
+
+    # ------------------------------------------------------------------
+    def reset_latency_stats(self) -> None:
+        """Drop the finished-request latency window (e.g. after warm-up,
+        so compile-time TTFTs stay out of a measurement)."""
+        self._lat.clear()
+
+    def latency_stats(self) -> Dict[str, float]:
+        """Per-request latency percentiles over the retired-request
+        window (last ≤4096): ``ttft`` (submit → first token on host,
+        covers queueing + prefill) and ``e2e`` (submit → retirement).
+        Seconds."""
+        ttfts = sorted(t for t, _ in self._lat if t == t)
+        e2es = sorted(e for _, e in self._lat)
+
+        def pct(xs, q):
+            return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("nan")
+
+        return {"n": len(self._lat),
+                "ttft_p50_s": pct(ttfts, 0.50), "ttft_p90_s": pct(ttfts, 0.90),
+                "e2e_p50_s": pct(e2es, 0.50), "e2e_p90_s": pct(e2es, 0.90)}

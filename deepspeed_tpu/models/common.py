@@ -97,12 +97,18 @@ def _fused_ce(vocab_size: int, padded_vocab_size: int, ignore_index: int,
     Vp = padded_vocab_size
     padded = padded_vocab_size != vocab_size
 
-    def _chunk_stats(hc, wteT, tc):
-        """(C, E) × (E, Vp) → per-token logz/label-logit, fp32 math."""
-        logits = jnp.dot(hc, wteT, preferred_element_type=jnp.float32)
+    def _mask_pad(logits):
+        """Exclude padded vocab columns from the softmax (single source
+        of truth for fwd and both bwd modes)."""
         if padded:
             mask = jnp.arange(Vp) < vocab_size
             logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        return logits
+
+    def _chunk_stats(hc, wteT, tc):
+        """(C, E) × (E, Vp) → per-token logz/label-logit, fp32 math."""
+        logits = _mask_pad(jnp.dot(hc, wteT,
+                                   preferred_element_type=jnp.float32))
         valid = tc != ignore_index
         safe = jnp.where(valid, tc, 0)
         logz = jax.nn.logsumexp(logits, axis=-1)
@@ -137,19 +143,9 @@ def _fused_ce(vocab_size: int, padded_vocab_size: int, ignore_index: int,
 
         def body(dwteT, xs):
             hc, tc, logz, sv = xs
-            if save_logits:
-                logits = sv.astype(jnp.float32)
-                if padded:
-                    mask = jnp.arange(Vp) < vocab_size
-                    logits = jnp.where(mask, logits,
-                                       jnp.finfo(jnp.float32).min)
-            else:
-                logits = jnp.dot(hc, wteT,
-                                 preferred_element_type=jnp.float32)
-                if padded:
-                    mask = jnp.arange(Vp) < vocab_size
-                    logits = jnp.where(mask, logits,
-                                       jnp.finfo(jnp.float32).min)
+            logits = _mask_pad(
+                sv.astype(jnp.float32) if save_logits
+                else jnp.dot(hc, wteT, preferred_element_type=jnp.float32))
             valid = tc != ignore_index
             safe = jnp.where(valid, tc, 0)
             coeff = (g * valid).astype(jnp.float32)          # (C,)

@@ -432,9 +432,20 @@ class GPT2LMHeadModel(nn.Module):
             raise NotImplementedError(
                 "MoE + pipeline parallelism: the aux loss does not flow "
                 "through the pipeline loop yet; use ep with dp/fsdp/tp")
-        if cfg.n_layer % n_stages != 0:
-            raise ValueError(f"n_layer {cfg.n_layer} not divisible by pp={n_stages}")
-        local_layers = cfg.n_layer // n_stages
+        # Heterogeneous partitioning (reference pipe/module.py:363
+        # ``partition_layers`` uniform/param-count balancing): n_layer need
+        # not divide n_stages.  The stack is zero-PADDED to
+        # ceil(L/stages)·stages inside ``split_params`` — a zero-weight
+        # pre-LN block is an exact identity (both residual branches end in
+        # a zero-weight projection, so forward adds 0 and the cotangent
+        # through the branch is 0) — and ``merge_params`` slices grads
+        # back to the canonical L layers, so pad slots are re-created zero
+        # every step and can never drift.  For a homogeneous scanned stack
+        # "balance by params" degenerates to this uniform ceil split; the
+        # ≤ stages-1 pad layers cost their compute on the last stage.
+        local_layers = -(-cfg.n_layer // n_stages)          # ceil
+        padded_layers = local_layers * n_stages
+        n_pad = padded_layers - cfg.n_layer
 
         stage_stack = nn.scan(
             Block,
@@ -448,9 +459,18 @@ class GPT2LMHeadModel(nn.Module):
 
         def split_params(params):
             shared = {k: v for k, v in params.items() if k != "h"}
-            return shared, params["h"]
+            stage = params["h"]
+            if n_pad:
+                stage = jax.tree_util.tree_map(
+                    lambda l: jnp.concatenate(
+                        [l, jnp.zeros((n_pad,) + l.shape[1:], l.dtype)]),
+                    stage)
+            return shared, stage
 
         def merge_params(shared, stage):
+            if n_pad:
+                stage = jax.tree_util.tree_map(lambda l: l[:cfg.n_layer],
+                                               stage)
             return {**shared, "h": stage}
 
         def embed_fn(shared, mb):

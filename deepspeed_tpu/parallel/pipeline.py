@@ -72,18 +72,24 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
 
     def tick(carry, t):
         x_buf, loss_acc = carry
-        # stage 0 ingests microbatch t (garbage after t >= M, masked below)
+        # stage 0 ingests microbatch t — the embed runs under lax.cond so
+        # the OTHER stages skip it at run time (one embed per microbatch
+        # across the ring, not per stage; the predicate is uniform within
+        # each stage's dp/tp group so the branches stay collective-safe)
         mb_in = pick_mb(t)
-        h_in = embed_fn(shared_params, mb_in)
-        x = jnp.where(sid == 0, h_in, x_buf)
+        x = lax.cond(sid == 0,
+                     lambda: embed_fn(shared_params, mb_in),
+                     lambda: x_buf)
         y = stage_fn(stage_params, x)
-        # last stage emits microbatch t-(S-1) when valid
+        # last stage emits microbatch t-(S-1) when valid; the E×V loss
+        # head likewise runs only where/when it is consumed
         out_t = t - (S - 1)
         mb_out = pick_mb(out_t)
-        mb_loss = loss_fn(shared_params, y, mb_out)
         valid = jnp.logical_and(sid == S - 1,
                                 jnp.logical_and(out_t >= 0, out_t < M))
-        loss_acc = loss_acc + jnp.where(valid, mb_loss, 0.0)
+        loss_acc = loss_acc + lax.cond(
+            valid, lambda: loss_fn(shared_params, y, mb_out),
+            lambda: jnp.float32(0.0))
         x_next = lax.ppermute(y, axis, [(i, (i + 1) % S) for i in range(S)])
         return (x_next, loss_acc), None
 
@@ -155,7 +161,11 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
         f = t - sid
         do_fwd = jnp.logical_and(f >= 0, f < M)
         mb_f = pick_mb(f)
-        x = jnp.where(sid == 0, embed_fn(shared_params, mb_f), fwd_in)
+        # embed under lax.cond: ONE embed per microbatch (stage 0), the
+        # other stages take the buffer branch at run time
+        x = lax.cond(sid == 0,
+                     lambda: embed_fn(shared_params, mb_f),
+                     lambda: fwd_in)
         y = stage_fn(stage_params, x)
         slot_f = jnp.mod(jnp.maximum(f, 0), D)
         resid = jnp.where(
@@ -169,27 +179,43 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
         x_k = lax.dynamic_index_in_dim(
             resid, jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
         y_k, stage_vjp = jax.vjp(stage_fn, stage_params, x_k)
-        loss_k, head_vjp = jax.vjp(
-            lambda sh, h: loss_fn(sh, h, mb_k), shared_params, y_k)
-        # seed scale/M: grads must match d(scale · mean-over-M loss)
-        g_head_sh, ct_loss = head_vjp((scale / M).astype(loss_k.dtype))
         is_last = sid == S - 1
-        ct_y = jnp.where(is_last, ct_loss, ct_in)
+
+        # E×V loss head fwd+bwd only where it is consumed (last stage,
+        # in-window tick); elsewhere the cotangent arrives off the ring
+        def head_branch():
+            loss_k, head_vjp = jax.vjp(
+                lambda sh, h: loss_fn(sh, h, mb_k), shared_params, y_k)
+            # seed scale/M: grads must match d(scale · mean-over-M loss)
+            g_head_sh, ct_loss = head_vjp((scale / M).astype(loss_k.dtype))
+            return (jax.tree_util.tree_map(lambda l: l.astype(f32),
+                                           g_head_sh),
+                    ct_loss, loss_k.astype(f32) * scale)
+
+        def no_head_branch():
+            return (jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, f32), shared_params),
+                    ct_in, jnp.float32(0.0))
+
+        g_head_sh, ct_y, loss_k = lax.cond(
+            jnp.logical_and(is_last, do_bwd), head_branch, no_head_branch)
         g_st_k, ct_x = stage_vjp(ct_y)
-        g_emb_sh = jax.vjp(
-            lambda sh: embed_fn(sh, mb_k), shared_params)[1](ct_x)[0]
+        # embed backward only on stage 0 (its cotangent dies elsewhere)
+        g_emb_sh = lax.cond(
+            jnp.logical_and(sid == 0, do_bwd),
+            lambda: jax.tree_util.tree_map(
+                lambda l: l.astype(f32),
+                jax.vjp(lambda sh: embed_fn(sh, mb_k),
+                        shared_params)[1](ct_x)[0]),
+            lambda: jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, f32), shared_params))
 
         m_bwd = do_bwd.astype(f32)
-        m_head = m_bwd * is_last.astype(f32)
-        m_emb = m_bwd * (sid == 0).astype(f32)
         g_st = jax.tree_util.tree_map(
             lambda a, b: a + m_bwd * b.astype(f32), g_st, g_st_k)
         g_sh = jax.tree_util.tree_map(
-            lambda a, bh, be: a + m_head * bh.astype(f32)
-            + m_emb * be.astype(f32), g_sh, g_head_sh, g_emb_sh)
-        loss_acc = loss_acc + jnp.where(
-            jnp.logical_and(is_last, do_bwd),
-            loss_k.astype(f32) * scale, 0.0)
+            lambda a, bh, be: a + bh + be, g_sh, g_head_sh, g_emb_sh)
+        loss_acc = loss_acc + loss_k
 
         # ---- ring: activations down, cotangents up ----
         fwd_next = lax.ppermute(y, axis, [(i, (i + 1) % S) for i in range(S)])
@@ -364,7 +390,9 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
             mb_f = pick_mb(f)
             x = fwd_buf[v]
             if v == 0:                # only global chunk 0 ingests tokens
-                x = jnp.where(sid == 0, embed_fn(shared_params, mb_f), x)
+                x = lax.cond(sid == 0,
+                             lambda: embed_fn(shared_params, mb_f),
+                             lambda: fwd_buf[0])
             ys.append(stage_fn(params_v, x))
             slot_f = jnp.mod(jnp.maximum(f, 0), D)
             resid = jnp.where(
@@ -380,27 +408,46 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
                 resid[v], jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
             y_k, stage_vjp = jax.vjp(stage_fn, params_v, x_k)
             if v == V - 1:            # final chunk: loss head seeds ct
-                loss_k, head_vjp = jax.vjp(
-                    lambda sh, h: loss_fn(sh, h, mb_k), shared_params, y_k)
-                g_head_sh, ct_loss = head_vjp(
-                    (scale / M).astype(loss_k.dtype))
                 is_final = sid == S - 1
-                ct_y = jnp.where(is_final, ct_loss, ct_buf[v])
-                m_head = do_bwd.astype(f32) * is_final.astype(f32)
-                g_sh = jax.tree_util.tree_map(
-                    lambda a, b: a + m_head * b.astype(f32), g_sh, g_head_sh)
-                loss_acc = loss_acc + jnp.where(
+
+                def head_branch():
+                    loss_k, head_vjp = jax.vjp(
+                        lambda sh, h: loss_fn(sh, h, mb_k),
+                        shared_params, y_k)
+                    g_head_sh, ct_loss = head_vjp(
+                        (scale / M).astype(loss_k.dtype))
+                    return (jax.tree_util.tree_map(
+                                lambda l: l.astype(f32), g_head_sh),
+                            ct_loss, loss_k.astype(f32) * scale)
+
+                def no_head_branch():
+                    return (jax.tree_util.tree_map(
+                                lambda p: jnp.zeros(p.shape, f32),
+                                shared_params),
+                            ct_buf[v], jnp.float32(0.0))
+
+                # head fwd+bwd runs only on the final stage's consuming
+                # ticks (lax.cond, not compute-and-mask)
+                g_head_sh, ct_y, loss_k = lax.cond(
                     jnp.logical_and(is_final, do_bwd),
-                    loss_k.astype(f32) * scale, 0.0)
+                    head_branch, no_head_branch)
+                g_sh = jax.tree_util.tree_map(
+                    lambda a, b: a + b, g_sh, g_head_sh)
+                loss_acc = loss_acc + loss_k
             else:
                 ct_y = ct_buf[v]
             g_st_v, ct_x = stage_vjp(ct_y)
             if v == 0:                # global chunk 0: embed backward
-                g_emb_sh = jax.vjp(
-                    lambda sh: embed_fn(sh, mb_k), shared_params)[1](ct_x)[0]
-                m_emb = do_bwd.astype(f32) * (sid == 0).astype(f32)
+                g_emb_sh = lax.cond(
+                    jnp.logical_and(sid == 0, do_bwd),
+                    lambda: jax.tree_util.tree_map(
+                        lambda l: l.astype(f32),
+                        jax.vjp(lambda sh: embed_fn(sh, mb_k),
+                                shared_params)[1](ct_x)[0]),
+                    lambda: jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, f32), shared_params))
                 g_sh = jax.tree_util.tree_map(
-                    lambda a, b: a + m_emb * b.astype(f32), g_sh, g_emb_sh)
+                    lambda a, b: a + b, g_sh, g_emb_sh)
             m_bwd = do_bwd.astype(f32)
             cts.append(ct_x)
             # accumulate chunk grads into the stacked local-slot layout

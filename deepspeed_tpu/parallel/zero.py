@@ -104,6 +104,15 @@ def resolve_tp(spec: P, shape: tuple, mesh, rules: dict) -> P:
         if axis is not None:
             size = _axis_size(mesh, axis)
             if d < len(shape) and shape[d] % size != 0:
+                if name == "layers":
+                    # heterogeneous pipeline partitioning: an uneven
+                    # stacked-layer dim cannot shard over pp (pjit wants
+                    # even splits), so the stored stack stays replicated;
+                    # the pipeline step zero-pads to ceil and reshards
+                    # into the manual-pp shard_map per step.  Divisible
+                    # layer counts keep the memory-optimal pp sharding.
+                    entries.append(None)
+                    continue
                 raise ValueError(
                     f"param dim {d} (logical {name!r}, size {shape[d]}) not divisible "
                     f"by mesh axis {axis!r} size {size}")

@@ -278,6 +278,14 @@ class Engine:
             from ..parallel.pipeline import interleaved_perm
 
             V = int(self.config.pipeline.get("virtual_stages", 2))
+            if hasattr(model, "cfg") and getattr(model.cfg, "n_layer", 0) \
+                    % (self.pp_size * V):
+                raise NotImplementedError(
+                    "interleaved schedule stores the stack pre-permuted in "
+                    "chunk units and does not compose with uneven "
+                    f"(padded) partitioning: n_layer {model.cfg.n_layer} "
+                    f"% (pp {self.pp_size} * virtual {V}) != 0 — use the "
+                    "1f1b/gpipe schedule or a divisible layer count")
             self._interleave = interleaved_perm(self.pp_size, V)
 
         if model_parameters is not None:
@@ -918,12 +926,16 @@ class Engine:
                     jnp.asarray(x), NamedSharding(self.mesh, P(*dims)))
 
             batches = jax.tree_util.tree_map(put, batch)
-        self._tput.start()
+        from ..utils.heartbeat import beat
+
+        beat()   # launcher failure detector: a long multi-step program
+        self._tput.start()   # (or its compile) must not look like a hang
         self._state, losses = self._compiled_multi_step(steps, stacked)(
             self._state, batches)
         self.global_steps += steps
         self.micro_steps += steps * self.gradient_accumulation_steps
         self.global_samples += steps * B
+        beat()
         self._tput.stop(result=losses)
         return losses
 
