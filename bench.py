@@ -173,15 +173,31 @@ def bench_northstar(steps: int = 8):
     # dims policy; scanned stack OOMs (monolithic (48,...) fp32 grads)
     cfg = gpt2_config(preset, n_positions=seq, scan_layers=not on_tpu,
                       remat=True, remat_policy="dots_saveable",
-                      attn_impl="auto")
-    model = GPT2LMHeadModel(cfg)
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+                      attn_impl="auto",
+                      loss_chunk=8192 if on_tpu else None)
+    base_cfg = {
         "train_micro_batch_size_per_gpu": micro,
         "optimizer": {"type": "adamw8bit",
                       "params": {"lr": 1e-4, "weight_decay": 0.1}},
         "zero_optimization": {"stage": 3},
         "steps_per_print": 10**6,
-    })
+    }
+    if os.environ.get("DS_TPU_BENCH_AUTOTUNE"):
+        # machine-reproduce the recipe instead of trusting the prose
+        # (autotuner northstar space; compile-probe pruning, live
+        # top-k measurement — costs many compiles over the tunnel)
+        from deepspeed_tpu.autotuning import Autotuner
+
+        tuner = Autotuner.northstar_space(
+            GPT2LMHeadModel(cfg), base_cfg, seq_len=seq)
+        base_cfg = tuner.tune(measure_top_k=2)
+        mesh_mod.set_mesh(None)
+        for k, v in (base_cfg.get("model_overrides") or {}).items():
+            cfg = __import__("dataclasses").replace(cfg, **{k: v})
+        print(f"# autotuned northstar: {base_cfg.get('autotuned')}",
+              flush=True)
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=base_cfg)
     engine.init_params()
     ids = np.random.default_rng(0).integers(
         0, cfg.vocab_size, size=(engine.train_batch_size, seq)).astype(np.int32)
@@ -230,13 +246,14 @@ def bench_train():
         # activations fit, and skipping recompute buys ~1.5% over the
         # remat config; micro 16/32, bigger flash tiles, and jnp
         # attention all trail.  Round 3: custom-vjp fused CE head
-        # (loss_chunk, recompute mode) measured +0.9% e2e — the fp32
-        # (B,S,V) logits cotangent never materializes.
+        # (loss_chunk, recompute mode) +0.9%; gradient accumulation 4
+        # with bf16 accumulation amortizes the optimizer pass over 4×
+        # the tokens (+4.4% measured, BENCH_NORTHSTAR round-3 table).
         preset, seq, micro, remat, scan = MODEL, SEQ, 24, False, False
-        chunk = 1 << 30
+        chunk, gas = 1 << 30, 4
     else:  # CI / smoke fallback
         preset, seq, micro, remat, scan = "gpt2-tiny", 128, 4, False, True
-        chunk = None
+        chunk, gas = None, 1
 
     cfg = gpt2_config(preset, n_positions=seq, scan_layers=scan, remat=remat,
                       remat_policy="dots_with_no_batch_dims_saveable",

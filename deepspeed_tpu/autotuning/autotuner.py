@@ -50,6 +50,16 @@ class TrialResult:
         return -self.est_step_time if self.fits else -float("inf")
 
 
+def _merge_optimizer(base: dict, override: dict) -> dict:
+    """Merge an optimizer-variant dict over a base optimizer config
+    (type-level keys replace; nested ``params`` merge key-wise)."""
+    out = dict(base)
+    out.update({k: v for k, v in override.items() if k != "params"})
+    if "params" in override:
+        out["params"] = dict(out.get("params", {}), **override["params"])
+    return out
+
+
 def _chip_spec():
     import jax
 
@@ -72,6 +82,7 @@ class Autotuner:
                  zero_stages: Optional[list[int]] = None,
                  remat_options: Optional[list[bool]] = None,
                  kernel_options: Optional[list[dict]] = None,
+                 optimizer_options: Optional[list[dict]] = None,
                  hbm_budget_fraction: float = 0.9,
                  seq_len: Optional[int] = None):
         self.model = model
@@ -119,9 +130,40 @@ class Autotuner:
                     for blk in ((1024, 1024), (512, 512), (256, 256))
                     if blk != tuple(current)
                 ] + [{"flash_heads_per_program": 2}]
+        # optimizer variants (dicts merged over base optimizer config):
+        # int8 Adam moments are THE memory lever for billion-param
+        # single-chip regimes, so they are part of the search space
+        self.optimizer_options = optimizer_options or [{}]
         self.hbm_budget = _chip_spec()["hbm"] * hbm_budget_fraction
         self.seq_len = seq_len
         self.results: list[TrialResult] = []
+
+    @classmethod
+    def northstar_space(cls, model, base_config: dict, **kw):
+        """The billion-param single-chip (north-star) search space
+        (round-2 verdict item 8): ZeRO-3 × micro 1-4 × remat policy ×
+        loss-head chunking × scanned-vs-unrolled stack × {adamw,
+        adamw8bit}.  Compile-time memory probes prune what cannot fit
+        (e.g. fp32 Adam moments at 1.5B); pass ``measure_top_k`` to
+        ``tune()`` to rank survivors on the chip."""
+        kernels: list[dict] = [
+            {"scan_layers": False, "loss_chunk": None},
+            {"scan_layers": False, "loss_chunk": 8192},
+            {"scan_layers": False, "loss_chunk": 8192,
+             "remat_policy": "dots_with_no_batch_dims_saveable"},
+            # scanned stack: expected to OOM at 1.5B (monolithic stacked
+            # fp32 grads) — kept in the space so the PROBE proves it
+            {"scan_layers": True, "loss_chunk": 8192},
+        ]
+        return cls(model, base_config,
+                   micro_batches=kw.pop("micro_batches", [1, 2, 3, 4]),
+                   zero_stages=kw.pop("zero_stages", [3]),
+                   remat_options=kw.pop("remat_options", [True, False]),
+                   kernel_options=kw.pop("kernel_options", kernels),
+                   optimizer_options=kw.pop(
+                       "optimizer_options",
+                       [{"type": "adamw8bit"}, {"type": "adamw"}]),
+                   **kw)
 
     @staticmethod
     def _flash_possible(model) -> bool:
@@ -132,7 +174,8 @@ class Autotuner:
         return getattr(model.cfg, "attn_impl", "jnp") in ("auto", "flash")
 
     def _trial_engine(self, stage: int, micro: int, remat: bool,
-                      kernel: Optional[dict] = None):
+                      kernel: Optional[dict] = None,
+                      opt: Optional[dict] = None):
         import dataclasses as dc
 
         import deepspeed_tpu
@@ -152,19 +195,23 @@ class Autotuner:
                                         stage=stage)
         cfg["train_micro_batch_size_per_gpu"] = micro
         cfg.setdefault("optimizer", {"type": "adamw", "params": {"lr": 1e-4}})
+        if opt:
+            cfg["optimizer"] = _merge_optimizer(cfg["optimizer"], opt)
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
         return engine
 
     def _probe(self, stage: int, micro: int, remat: bool,
-               kernel: Optional[dict] = None) -> TrialResult:
+               kernel: Optional[dict] = None,
+               opt: Optional[dict] = None) -> TrialResult:
         import jax
 
         overrides = {"zero_optimization.stage": stage,
                      "train_micro_batch_size_per_gpu": micro,
-                     "remat": remat, "kernel": dict(kernel or {})}
+                     "remat": remat, "kernel": dict(kernel or {}),
+                     "optimizer": dict(opt or {})}
         result = TrialResult(config_overrides=overrides)
         try:
-            engine = self._trial_engine(stage, micro, remat, kernel)
+            engine = self._trial_engine(stage, micro, remat, kernel, opt)
             batch = engine.model.dummy_inputs(
                 batch_size=engine.train_batch_size, seq_len=self.seq_len)
             abstract = engine.abstract_state(batch)
@@ -203,13 +250,16 @@ class Autotuner:
             for remat in self.remat_options:
                 for micro in self.micro_batches:
                     for kernel in self.kernel_options:
-                        r = self._probe(stage, micro, remat, kernel)
-                        self.results.append(r)
-                        status = "OOM/err" if (not r.fits or r.error) else \
-                            f"est {1e3*r.est_step_time:.1f}ms"
-                        log_dist(f"autotune stage={stage} micro={micro} "
-                                 f"remat={remat} kernel={kernel}: {status}",
-                                 ranks=[0])
+                        for opt in self.optimizer_options:
+                            r = self._probe(stage, micro, remat, kernel,
+                                            opt)
+                            self.results.append(r)
+                            status = "OOM/err" if (not r.fits or r.error) \
+                                else f"est {1e3*r.est_step_time:.1f}ms"
+                            log_dist(
+                                f"autotune stage={stage} micro={micro} "
+                                f"remat={remat} kernel={kernel} "
+                                f"opt={opt}: {status}", ranks=[0])
         viable = [r for r in self.results if r.fits and not r.error]
         if not viable:
             raise RuntimeError(
@@ -232,8 +282,21 @@ class Autotuner:
             # returned config (engine applies it to the model's layer stack)
             cfg["activation_checkpointing"] = dict(
                 cfg.get("activation_checkpointing", {}), enabled=True)
-        if best.config_overrides.get("kernel"):
-            cfg["model_overrides"] = dict(best.config_overrides["kernel"])
+        # model_overrides carry the kernel knobs AND the remat flag itself:
+        # the engine only UPGRADES remat (False→True) via
+        # activation_checkpointing, so a remat=False winner must force the
+        # model config down or a remat=True caller silently runs a
+        # different recipe than the one measured
+        mo = dict(best.config_overrides.get("kernel") or {})
+        if hasattr(self.model, "cfg") and hasattr(self.model.cfg, "remat"):
+            mo.setdefault("remat", bool(best.config_overrides["remat"]))
+        if mo:
+            cfg["model_overrides"] = mo
+        if best.config_overrides.get("optimizer"):
+            cfg["optimizer"] = _merge_optimizer(
+                cfg.get("optimizer", {"type": "adamw",
+                                      "params": {"lr": 1e-4}}),
+                best.config_overrides["optimizer"])
         cfg["autotuned"] = best.config_overrides
         return cfg
 
@@ -248,7 +311,8 @@ class Autotuner:
                 o = r.config_overrides
                 engine = self._trial_engine(o["zero_optimization.stage"],
                                             o["train_micro_batch_size_per_gpu"],
-                                            o["remat"], o.get("kernel"))
+                                            o["remat"], o.get("kernel"),
+                                            o.get("optimizer"))
                 engine.init_params()
                 batch = engine.model.dummy_inputs(
                     batch_size=engine.train_batch_size, seq_len=self.seq_len)

@@ -73,7 +73,13 @@ class GPT2Config:
     # zero extra FLOPs — small models where the head dominates) vs
     # recompute them (False; zero O(N·V) residency — large models where
     # HBM is the binding constraint).  See models/common.py _fused_ce.
+    # (round-3 measured: replay LOSES 20% e2e at 125M — bf16 logits
+    # traffic costs more than the recompute matmul; keep False)
     loss_save_logits: bool = False
+    # Pallas fused CE head (ops/pallas/fused_ce.py): matmul + online
+    # logsumexp in VMEM, logits never in HBM either pass.  Engages only
+    # with loss_chunk set (the chunked-loss output contract) on TPU.
+    loss_pallas: bool = False
 
     @property
     def padded_vocab_size(self) -> int:
@@ -386,14 +392,22 @@ class GPT2LMHeadModel(nn.Module):
         h = LayerNorm(cfg, name="ln_f")(h)
         if cfg.loss_chunk and labels is not None:
             # memory-bounded head: logits never fully materialize
-            from .common import chunked_lm_loss
+            from ..ops.pallas.fused_ce import supported as _ce_supported
+            from .common import chunked_lm_loss, pallas_lm_loss
 
             tgt = shift_labels(labels) if shift else labels
-            loss = chunked_lm_loss(
-                h, wte, tgt, vocab_size=cfg.vocab_size,
-                padded_vocab_size=cfg.padded_vocab_size,
-                chunk=cfg.loss_chunk, dtype=cfg.dtype,
-                save_logits=cfg.loss_save_logits)
+            if cfg.loss_pallas and on_tpu() and \
+                    _ce_supported(cfg.padded_vocab_size):
+                loss = pallas_lm_loss(
+                    h, wte, tgt, vocab_size=cfg.vocab_size,
+                    padded_vocab_size=cfg.padded_vocab_size,
+                    dtype=cfg.dtype)
+            else:
+                loss = chunked_lm_loss(
+                    h, wte, tgt, vocab_size=cfg.vocab_size,
+                    padded_vocab_size=cfg.padded_vocab_size,
+                    chunk=cfg.loss_chunk, dtype=cfg.dtype,
+                    save_logits=cfg.loss_save_logits)
             out = ModelOutput(loss=loss)
             if cfg.moe is not None:
                 out["aux_loss"] = aux_loss

@@ -61,10 +61,20 @@ def dot_product_attention(
     """
     if impl in ("ring", "ulysses"):
         return _sp_attention(q, k, v, causal=causal, scale=scale, kind=impl)
+    if impl == "skip":
+        # measurement probe ONLY: attention replaced by identity-on-q so
+        # an e2e A/B isolates the attention kernel's true step-time share
+        # (isolated kernel probes mislead — see BENCH_NORTHSTAR.md)
+        return q
     impl = _pick_impl(impl, q)
     if impl == "flash" and bias is None and mask is None and dropout_rate == 0.0:
         out = _flash_spmd(q, k, v, causal=causal, scale=scale,
                           flash_opts=flash_opts)
+        if out is not None:
+            return out
+    if impl == "flash_jax" and bias is None and mask is None \
+            and dropout_rate == 0.0:
+        out = _flash_jax(q, k, v, causal=causal, scale=scale)
         if out is not None:
             return out
     return _jnp_attention(q, k, v, causal=causal, bias=bias, mask=mask,
@@ -107,6 +117,54 @@ def _flash_spmd(q, k, v, *, causal, scale, interpret=False, flash_opts=None):
         return mapped(q, k, v)
     except Exception as e:  # unsupported shape/backend for the kernel
         _warn_once("flash_attention", f"{type(e).__name__}: {e}"[:200])
+        return None
+
+
+def _flash_jax(q, k, v, *, causal, scale):
+    """Stock JAX/Pallas TPU flash kernel
+    (``jax.experimental.pallas.ops.tpu.flash_attention``) as an alternate
+    backend — same dispatch contract as :func:`_flash_spmd` (shard_map
+    over batch/head axes on active meshes; None on unsupported
+    shape/backend so the caller falls back)."""
+    from functools import partial
+
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash)
+    except ImportError:
+        return None
+    from .pallas.spmd import kernel_mesh_plan, _warn_once
+
+    from ..comm.mesh import get_mesh
+
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    verdict, batch_axes = kernel_mesh_plan(B, heads=H, allow_tp=True)
+    if verdict is None:
+        return None
+
+    def kern(q, k, v):
+        out = jax_flash(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                        v.transpose(0, 2, 1, 3), causal=causal,
+                        sm_scale=scale)
+        return out.transpose(0, 2, 1, 3)
+
+    try:
+        if verdict == "direct":
+            return kern(q, k, v)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = get_mesh()
+        tp = mesh.shape.get("tp", 1)
+        spec = P(batch_axes if batch_axes else None, None,
+                 "tp" if tp > 1 else None, None)
+        mapped = shard_map(kern, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+        return mapped(q, k, v)
+    except Exception as e:
+        _warn_once("flash_jax", f"{type(e).__name__}: {e}"[:200])
         return None
 
 

@@ -25,6 +25,7 @@ from jax.experimental import pallas as pl
 NEG_INF = float("-inf")
 
 HEADS_PER_PROGRAM = 1   # module knob; see flash_attention()
+UNROLL_MAX = 4          # static-unroll K/Q sweeps at or below this length
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
@@ -66,7 +67,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
             return m_new, l, acc
 
-        m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        if nk <= UNROLL_MAX:
+            # short K sweeps (e.g. S=1024, block 512 → 2 iterations):
+            # a static python loop with a masked-skip select lets Mosaic
+            # software-pipeline the K/V streaming instead of paying the
+            # fori_loop's per-iteration sequencing
+            carry = (m0, l0, acc0)
+            for j in range(nk):
+                new = body(j, carry)
+                keep = jnp.asarray(j, jnp.int32) < hi
+                carry = jax.tree_util.tree_map(
+                    lambda n, c: jnp.where(keep, n, c), new, carry)
+            m, l, acc = carry
+        else:
+            m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[g] = (acc / l_safe[:, None]).astype(o_ref.dtype)
         m_safe = jnp.where(m == NEG_INF, 0.0, m)
@@ -102,8 +116,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                             preferred_element_type=jnp.float32)
 
-        dq = jax.lax.fori_loop(0, hi, body,
-                               jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+        dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+        if nk <= UNROLL_MAX:
+            dq = dq0
+            for j in range(nk):
+                keep = jnp.asarray(j, jnp.int32) < hi
+                dq = jnp.where(keep, body(j, dq), dq)
+        else:
+            dq = jax.lax.fori_loop(0, hi, body, dq0)
         dq_ref[g] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -142,7 +162,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
         dv0 = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
-        dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+        if nq <= UNROLL_MAX:
+            carry = (dk0, dv0)
+            for i in range(nq):
+                new = body(i, carry)
+                keep = jnp.asarray(i, jnp.int32) >= lo
+                carry = jax.tree_util.tree_map(
+                    lambda n, c: jnp.where(keep, n, c), new, carry)
+            dk, dv = carry
+        else:
+            dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
         dk_ref[g] = dk.astype(dk_ref.dtype)   # q was pre-scaled → dk has scale
         dv_ref[g] = dv.astype(dv_ref.dtype)
 

@@ -125,6 +125,39 @@ class Engine:
             self.lr_scheduler = get_lr_schedule(
                 self.config.scheduler.type, self.config.scheduler.params,
                 base_lr=self.config.optimizer.lr)
+        # 1-bit Adam with the compressed collective ON THE WIRE
+        # (runtime/onebit_comm.py; reference onebit/adam.py:14 +
+        # comm/nccl.py:52).  Opt-in: optimizer.params.comm_backend =
+        # "compressed".  The optax-level onebit family (no flag) keeps the
+        # state machine with XLA's dense reduction.
+        from . import constants as _C0
+
+        _ocfg0 = self.config.optimizer
+        self._onebit_comm = (
+            _ocfg0.type in (_C0.ONEBIT_ADAM_OPTIMIZER,)
+            and _ocfg0.extra.get("comm_backend") == "compressed")
+        if self._onebit_comm:
+            bad_axes = {a: s for a, s in mesh.shape.items()
+                        if a not in ("dp", "fsdp") and s > 1}
+            problems = [
+                ("zero stage 0 required (the compressed collective "
+                 "replaces the gradient reduction)", self.zero_stage != 0),
+                ("pure dp/fsdp mesh required", bool(bad_axes)),
+                ("gradient_accumulation_steps must be 1",
+                 self.config.gradient_accumulation_steps > 1),
+                ("fp16 loss scaling unsupported (use bf16)",
+                 self.config.fp16.enabled),
+                ("gradient_clipping unsupported on the 1-bit path",
+                 self.config.gradient_clipping > 0),
+                ("sparse_gradients unsupported on the 1-bit path",
+                 self.config.sparse_gradients),
+            ]
+            bad = [msg for msg, cond in problems if cond]
+            if bad:
+                raise NotImplementedError(
+                    "optimizer.params.comm_backend=compressed: "
+                    + "; ".join(bad))
+
         self.offload_device = self.config.zero.offload_optimizer.device
         if self.offload_device not in ("none", "cpu", "nvme"):
             raise ValueError(f"offload_optimizer.device {self.offload_device!r}")
@@ -142,22 +175,16 @@ class Engine:
                                  "(reference constraint)")
             if self.config.fp16.enabled:
                 raise NotImplementedError("fp16 + param offload: use bf16")
-            if self.config.gradient_accumulation_steps > 1:
-                raise NotImplementedError(
-                    "param offload streams one global batch per step; set "
-                    "gradient_accumulation_steps=1 (raise the micro size)")
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "param offload is single-process: each host would step "
-                    "its own master without a grad allreduce")
             if self.config.progressive_layer_drop.get("enabled"):
                 raise NotImplementedError(
                     "progressive_layer_drop does not thread through the "
                     "param-offload stage loop; disable one of them")
-            if self.n_devices > 1:
-                logger.warning(
-                    "param offload streams through ONE device; the other "
-                    f"{self.n_devices - 1} mesh devices stay idle")
+            non_data = {a: s for a, s in self.mesh.shape.items()
+                        if a not in ("dp", "fsdp") and s > 1}
+            if non_data:
+                raise NotImplementedError(
+                    "param offload streams flat ZeRO-3 shards over the "
+                    f"dp/fsdp axes only; got extra mesh axes {non_data}")
         if self.offload_device != "none" and self.config.fp16.enabled:
             raise NotImplementedError("fp16 + optimizer offload: use bf16")
         if self.offload_device != "none":
@@ -172,6 +199,21 @@ class Engine:
                     optax.clip_by_global_norm(self.config.gradient_clipping), self.tx)
         else:
             self.tx = build_tx(self.config, learning_rate=self.lr_scheduler)
+        if self._onebit_comm:
+            # opt_state IS the 1-bit comm state (per-worker momentum +
+            # error buffers); the update runs inside the shard_map step,
+            # not through optax
+            from . import onebit_comm as _obc
+
+            _W = int(np.prod([mesh.shape[a] for a in ("dp", "fsdp")]))
+
+            def _raise(*a, **k):
+                raise RuntimeError(
+                    "onebit comm_backend=compressed: the update happens "
+                    "inside the compiled shard_map step")
+
+            self.tx = optax.GradientTransformation(
+                functools.partial(_obc.init_state, W=_W), _raise)
         self.optimizer = self.tx  # returned from deepspeed_tpu.initialize
         # Fused adam8bit: one Pallas HBM pass per leaf instead of the
         # XLA chain's fp32 moment round trips (the round-2 measured
@@ -502,7 +544,7 @@ class Engine:
             from .param_offload import ParamOffloadRunner, host_init_tree
 
             self._param_offload = ParamOffloadRunner(
-                self.model, self.config, self.lr_scheduler)
+                self.model, self.config, self.lr_scheduler, self.mesh)
             host = params if params is not None else host_init_tree(
                 _unbox(boxed), seed=self.config.seed,
                 std=getattr(self.model.cfg, "initializer_range", 0.02))
@@ -560,8 +602,13 @@ class Engine:
                                                  rules=self._partition_rules)
         self._grad_specs = stage3_like if stage >= 2 else self._param_specs
         opt_like = stage3_like if stage >= 1 else self._param_specs
-        self._opt_specs = zero_lib.opt_state_specs(
-            self.tx, boxed_abstract_params, opt_like)
+        if self._onebit_comm:
+            from . import onebit_comm as _obc
+
+            self._opt_specs = _obc.state_specs(_unbox(boxed_abstract_params))
+        else:
+            self._opt_specs = zero_lib.opt_state_specs(
+                self.tx, boxed_abstract_params, opt_like)
 
     def abstract_state(self, example_batch=None) -> "TrainState":
         """Abstract (ShapeDtypeStruct + sharding) TrainState — compile-time
@@ -791,6 +838,8 @@ class Engine:
         :attr:`_compiled_train_step`, scanned by :meth:`train_batches`."""
         if self.pp_size > 1:
             return self._pipeline_step_body
+        if self._onebit_comm:
+            return self._onebit_step_body
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         pld_on = self.progressive_layer_drop is not None
@@ -823,6 +872,36 @@ class Engine:
                     state.params, batch, rng, scale, pld_theta)
                 g_sum = self._constrain(g_sum, self._grad_specs)
             return self._apply_grads(state, g_sum, loss_sum, jnp.float32(gas))
+
+        return step_fn
+
+    @functools.cached_property
+    def _onebit_step_body(self):
+        """1-bit Adam step with the packed compressed collective on the
+        wire (runtime/onebit_comm.py; verdict item 7)."""
+        from . import onebit_comm as _obc
+
+        ocfg = self.config.optimizer
+        b1, b2 = ocfg.betas
+        step = _obc.step_factory(
+            self.mesh,
+            lambda p, b, r: self._loss_fn(p, b, r, deterministic=False),
+            self.lr_scheduler, b1=b1, b2=b2, eps=ocfg.eps,
+            weight_decay=ocfg.weight_decay,
+            freeze_step=int(ocfg.extra.get("freeze_step", 100)))
+
+        def step_fn(state: TrainState, batch, *extra):
+            rng = jax.random.fold_in(self._base_rng, state.step)
+            loss, params_new, ob_state = step(
+                state.params, state.opt_state, batch, rng)
+            metrics = {"loss": loss,
+                       "grad_norm": jnp.float32(0.0),  # not materialized
+                       "lr": self.lr_scheduler(state.step),
+                       "overflow": jnp.bool_(False)}
+            new_state = TrainState(step=state.step + 1, params=params_new,
+                                   opt_state=ob_state,
+                                   loss_scale=state.loss_scale)
+            return new_state, metrics
 
         return step_fn
 

@@ -1,34 +1,50 @@
 """ZeRO-3 parameter offload: host/NVMe-resident parameters, layer-group
-streaming through the chip.
+streaming through the WHOLE device mesh.
 
 Analog of the reference ``AsyncPartitionedParameterSwapper``
 (``/root/reference/deepspeed/runtime/swap_tensor/partitioned_param_swapper.py:37``)
-+ ``zero.Init(remote_device=...)``
-(``partition_parameters.py:529``): models whose parameters exceed device
-HBM train by keeping the fp32 master (and Adam moments) in host RAM or
-NVMe and paging parameters through the device one LAYER GROUP at a time.
++ ``zero.Init(remote_device=...)`` (``partition_parameters.py:529``):
+models whose parameters exceed device HBM train by keeping the fp32
+master (and Adam moments) in host RAM or NVMe and paging parameters
+through the device one LAYER GROUP at a time.
 
-TPU-native shape of the idea: host↔device transfers cannot happen inside
-one XLA program, so instead of one jitted train step the runner drives
-three small compiled programs — ``embed``, ``stage`` (a group of layers),
-``head`` — in a Python loop:
+TPU-native shape of the idea (round 3 — mesh-aware): each layer group's
+parameter tree flattens into ONE fp32 vector, zero-padded to a multiple
+of the data-axis device count W and partitioned:
 
-    fwd:  for g in 0..G-1:  put(group g) → h = stage(group_g, h)
-    bwd:  for g in G-1..0:  put(group g) → (g_g, ct) = vjp(stage)(ct)
-          stream g_g to host → multithreaded CPU-Adam updates group g
-          WHILE the device runs group g-1's backward (overlap)
+- HOST: every process owns the contiguous byte ranges of the flat master
+  that back its addressable devices' shards — masters, int-moments and
+  the C++ CPU-Adam are sized to the LOCAL partition (the reference's
+  per-rank partition, ``partitioned_param_swapper.py:37``), so host RAM
+  scales 1/P with process count.
+- DEVICE: the bf16 mirror streams as a ``jax.Array`` sharded
+  ``P(("dp","fsdp"))`` over ALL mesh devices (multi-process ranks
+  contribute their local shards via
+  ``jax.make_array_from_single_device_arrays``).  Inside the compiled
+  stage functions the vector unflattens to the layer tree, so XLA
+  all-gathers shards at use — the ZeRO-3 gather — and the backward's
+  flat-gradient output is constrained back to the same sharding, so the
+  cross-replica gradient SUM lowers to a reduce-scatter.  The round-2
+  gaps (single process, one streaming device, no grad reduction) all
+  close in this one design: batch rows shard over the same axes, so
+  data-parallel reduction is ordinary SPMD.
 
-Every group has identical shapes, so each program compiles ONCE.  Device
-residency is bounded by two group buffers (current + prefetch) plus the
-G+1 inter-group activations — independent of model size.  bf16 streams
-both ways (half the bytes); masters/moments stay fp32 on host
-(``ops/adam.py`` CPU-Adam, OpenMP kernels in ``csrc/cpu_adam.cpp``).
-``device="nvme"`` backs master+moment buffers with ``np.memmap`` files
-under ``nvme_path`` so resident set pages to disk.
+Drive loop per optimizer step (G groups, ``gas`` micro-batches):
 
-Engine integration: ``zero_optimization.offload_param.device`` routes
-``train_batch`` here (requires ZeRO stage 3 and a model exposing
-``pipeline_fns``, whose layer-stacked params give the group slicing).
+    for each micro m:
+      fwd:  for g in 0..G-1:  put(group g) → h = stage(group_g, h)
+      bwd:  for g in G-1..0:  (flat_g, sqnorm_g, ct) = vjp(stage)(ct)
+            fetch LOCAL shard of flat_g → hold-buffer[g] (+=)
+    update: clip scale from the device-accumulated global sqnorm, then
+            per-group C++ CPU-Adam on the local master slices
+            (gas==1 and no clipping keeps the round-2 fast path: group
+            g's host update overlaps the device backward of group g-1).
+
+``device="nvme"`` backs masters AND grad hold-buffers with ``np.memmap``
+under ``nvme_path`` so resident set pages to disk; with clipping or
+gas>1 in "cpu" mode the hold-buffers cost one local partition of RAM —
+the reference's own cpu_offload gradient-buffer footprint
+(``stage_1_and_2.py`` cpu_offload path).
 """
 from __future__ import annotations
 
@@ -38,9 +54,12 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..utils import log_dist
 from ..ops.adam import DeepSpeedCPUAdam
+
+DATA_AXES = ("dp", "fsdp")
 
 
 def _to_f32(a) -> np.ndarray:
@@ -69,7 +88,8 @@ def host_init_tree(abstract_tree, seed: int = 0, std: float = 0.02):
 class ParamOffloadRunner:
     """Host-resident-parameter training loop (see module docstring)."""
 
-    def __init__(self, model, config, lr_scheduler, groups: Optional[int] = None):
+    def __init__(self, model, config, lr_scheduler, mesh,
+                 groups: Optional[int] = None):
         if not hasattr(model, "pipeline_fns"):
             raise NotImplementedError(
                 "offload_param needs a model with pipeline_fns (layer-"
@@ -77,6 +97,7 @@ class ParamOffloadRunner:
         self.model = model
         self.config = config
         self.lr_scheduler = lr_scheduler
+        self.mesh = mesh
         cfg = model.cfg
         n_layer = cfg.n_layer
         if groups is None:
@@ -85,6 +106,7 @@ class ParamOffloadRunner:
             raise ValueError(f"n_layer {n_layer} not divisible into "
                              f"{groups} groups")
         self.G = groups
+        self.gas = config.gradient_accumulation_steps
         (self._embed_fn, self._stage_fn, self._loss_fn,
          self._split, self._merge) = model.pipeline_fns(groups)
         self.device = config.zero.offload_param.device
@@ -104,25 +126,60 @@ class ParamOffloadRunner:
         self.step_count = 0
         self._state = None
 
-        self._jit_embed = jax.jit(self._embed_fn)
-        self._jit_fwd = jax.jit(self._stage_fn)
+        # data-axis sharding: batch rows AND the flat group vectors ride
+        # the same devices — ZeRO-3 partitioning with automatic gather
+        self.W = int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+        self._vec_sh = NamedSharding(mesh, P(DATA_AXES))
+        self._repl_sh = NamedSharding(mesh, P())
 
-        def bwd(gp, h_in, ct):
-            _, vjp = jax.vjp(self._stage_fn, gp, h_in)
-            return vjp(ct)
+        self._build_compiled()
 
-        self._jit_bwd = jax.jit(bwd)
+    # ------------------------------------------------------------------
+    # compiled pieces: stage fns over the FLAT group vector
+    # ------------------------------------------------------------------
+    def _unflatten_jnp(self, flat, dtype):
+        """flat (gsz_p,) → layer-group tree (inside jit; slices transpose
+        to pad-scatter in the vjp, so flat grads fall out for free)."""
+        leaves, off = [], 0
+        for s in self._g_shapes:
+            n = int(np.prod(s))
+            leaves.append(jax.lax.slice(flat, (off,), (off + n,))
+                          .reshape(s).astype(dtype))
+            off += n
+        return jax.tree_util.tree_unflatten(self._h_def, leaves)
+
+    def _build_compiled(self):
+        dtype = jnp.bfloat16
+
+        def fwd(flat, h):
+            return self._stage_fn(self._unflatten_jnp(flat, dtype), h)
+
+        def bwd(flat, h_in, ct, want_sq: bool):
+            def f(fl, h):
+                return self._stage_fn(self._unflatten_jnp(fl, dtype), h)
+
+            _, vjp = jax.vjp(f, flat, h_in)
+            g_flat, g_h = vjp(ct)
+            g_flat = g_flat.astype(jnp.float32)
+            g_flat = jax.lax.with_sharding_constraint(g_flat, self._vec_sh)
+            # device-side ‖g‖² only where the clip path consumes it —
+            # the fast path must not pay the reduce or its blocking fetch
+            sq = jnp.sum(g_flat ** 2) if want_sq else jnp.float32(0.0)
+            return g_flat, g_h, sq
 
         def head(shared, h, mb):
-            return jax.value_and_grad(
-                lambda s, hh: self._loss_fn(s, hh, mb), argnums=(0, 1))(
-                    shared, h)
-
-        self._jit_head = jax.jit(head)
+            loss, (g_sh, ct) = jax.value_and_grad(
+                lambda s, hh: self._loss_fn(s, hh, mb),
+                argnums=(0, 1))(shared, h)
+            return loss, g_sh, ct
 
         def embed_bwd(shared, mb, ct):
             return jax.vjp(lambda s: self._embed_fn(s, mb), shared)[1](ct)[0]
 
+        self._jit_embed = jax.jit(self._embed_fn)
+        self._jit_fwd = jax.jit(fwd)
+        self._jit_bwd = jax.jit(bwd, static_argnums=(3,))
+        self._jit_head = jax.jit(head)
         self._jit_embed_bwd = jax.jit(embed_bwd)
 
     # ------------------------------------------------------------------
@@ -133,16 +190,27 @@ class ParamOffloadRunner:
                              dtype=np.float32, mode="w+", shape=(size,))
         return np.zeros(size, np.float32)
 
+    def _local_ranges(self):
+        """Global (start, stop) slices of the flat vector backed by THIS
+        process's devices, sorted — host masters cover exactly these."""
+        sh = self._vec_sh
+        idx_map = sh.addressable_devices_indices_map((self._gsz_p,))
+        ranges = sorted((s[0].start or 0, s[0].stop or self._gsz_p)
+                        for s in idx_map.values())
+        return ranges
+
     def init_host(self, params_host: Any):
         """Adopt a host param tree (numpy/jax leaves) as the fp32 master.
 
         ``params_host`` layout must match ``model.init`` (shared leaves +
-        the scanned ``h`` stack)."""
+        the scanned ``h`` stack).  Multi-process: every process passes the
+        FULL tree (host init is cheap vs training); each keeps only its
+        local partition."""
         unboxed = jax.tree_util.tree_map(
             lambda x: getattr(x, "value", x), params_host,
             is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
         shared, h = self._split(unboxed)
-        # ---- shared: host master + device bf16 mirror -----------------
+        # ---- shared: replicated host master + device bf16 mirror ------
         sh_leaves, self._sh_def = jax.tree_util.tree_flatten(shared)
         self._sh_shapes = [l.shape for l in sh_leaves]
         self._sh_master = self._alloc("shared", sum(
@@ -151,30 +219,50 @@ class ParamOffloadRunner:
                        out=self._sh_master)
         self._sh_opt = DeepSpeedCPUAdam(self._sh_master.size, **self._opt_kw)
         self._shared_dev = self._place_shared()
-        # ---- layer groups ---------------------------------------------
-        G = self.G
+        # ---- layer groups: flat, padded, partitioned ------------------
+        G, W = self.G, self.W
         h_leaves, self._h_def = jax.tree_util.tree_flatten(h)
         L = h_leaves[0].shape[0]
         Lg = L // G
         self._g_shapes = [(Lg,) + l.shape[1:] for l in h_leaves]
         self._g_sizes = [int(np.prod(s)) for s in self._g_shapes]
         gsz = sum(self._g_sizes)
-        self._g_master = [self._alloc(f"group{g}", gsz) for g in range(G)]
-        self._g_bf16: list = [None] * G
-        self._g_opt = [DeepSpeedCPUAdam(gsz, **self._opt_kw)
+        self._gsz = gsz
+        self._gsz_p = -(-gsz // W) * W          # pad to device multiple
+        self._ranges = self._local_ranges()
+        loc = sum(b - a for a, b in self._ranges)
+        self._g_master = [self._alloc(f"group{g}", loc) for g in range(G)]
+        import ml_dtypes
+
+        self._bf16 = ml_dtypes.bfloat16
+        self._g_bf16 = [np.zeros(loc, self._bf16) for _ in range(G)]
+        self._g_opt = [DeepSpeedCPUAdam(loc, **self._opt_kw)
                        for _ in range(G)]
         for g in range(G):
             flat = np.concatenate([
                 _to_f32(l[g * Lg:(g + 1) * Lg]).ravel() for l in h_leaves])
-            self._g_master[g][:] = flat
+            off = 0
+            for a, b in self._ranges:
+                take = np.zeros(b - a, np.float32)
+                src = flat[a:min(b, gsz)]
+                take[:src.size] = src
+                self._g_master[g][off:off + (b - a)] = take
+                off += b - a
             self._refresh_mirror(g)
+        # grad hold-buffers (clip / gas>1): same backend as the masters
+        self._g_hold = None
+        self._sh_hold = None
         self._state = True
         n = self._sh_master.size + gsz * G
         log_dist(f"param-offload master initialized on "
-                 f"{self.device}: {n/1e6:.1f}M params in {G} groups",
-                 ranks=[0])
+                 f"{self.device}: {n/1e6:.1f}M params in {G} groups, "
+                 f"{self.W} device shards, local partition "
+                 f"{loc/1e6:.1f}M/group", ranks=[0])
 
-    def _unflatten(self, flat: np.ndarray, shapes, treedef, dtype):
+    def _refresh_mirror(self, g: int):
+        self._g_bf16[g][:] = self._g_master[g].astype(self._bf16)
+
+    def _unflatten_np(self, flat: np.ndarray, shapes, treedef, dtype):
         leaves, off = [], 0
         for s in shapes:
             n = int(np.prod(s))
@@ -182,32 +270,65 @@ class ParamOffloadRunner:
             off += n
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
-    def _refresh_mirror(self, g: int):
-        import ml_dtypes
-
-        self._g_bf16[g] = self._unflatten(
-            self._g_master[g], self._g_shapes, self._h_def,
-            ml_dtypes.bfloat16)
-
     def _place_shared(self):
         import ml_dtypes
 
-        return jax.device_put(self._unflatten(
-            self._sh_master, self._sh_shapes, self._sh_def,
-            ml_dtypes.bfloat16))
+        tree = self._unflatten_np(self._sh_master, self._sh_shapes,
+                                  self._sh_def, ml_dtypes.bfloat16)
+        return jax.device_put(tree, self._repl_sh)
 
     def _put_group(self, g: int):
-        return jax.device_put(self._g_bf16[g])
+        """Assemble the sharded flat bf16 vector from local mirror blocks
+        (each process contributes exactly its devices' shards)."""
+        sh = self._vec_sh
+        idx_map = sh.addressable_devices_indices_map((self._gsz_p,))
+        arrs, devs = [], []
+        for dev, idx in idx_map.items():
+            a = idx[0].start or 0
+            b = idx[0].stop or self._gsz_p
+            off = self._block_offset(a)
+            arrs.append(jax.device_put(
+                self._g_bf16[g][off:off + (b - a)], dev))
+            devs.append(dev)
+        return jax.make_array_from_single_device_arrays(
+            (self._gsz_p,), sh, arrs)
+
+    def _block_offset(self, start: int) -> int:
+        off = 0
+        for a, b in self._ranges:
+            if a == start:
+                return off
+            off += b - a
+        raise KeyError(f"no local block starts at {start}")
+
+    def _fetch_local(self, arr) -> np.ndarray:
+        """Local partition of a sharded flat device array → (loc,) numpy
+        in block order (device_get of addressable shards only)."""
+        out = np.empty(sum(b - a for a, b in self._ranges), np.float32)
+        for shard in arr.addressable_shards:
+            idx = shard.index[0]
+            a = idx.start or 0
+            off = self._block_offset(a)
+            out[off:off + shard.data.shape[0]] = np.asarray(
+                shard.data, np.float32)
+        return out
+
+    def _shard_mb(self, mb):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                jnp.asarray(x),
+                NamedSharding(self.mesh,
+                              P(DATA_AXES, *([None] * (np.ndim(x) - 1))))),
+            mb)
 
     # ------------------------------------------------------------------
     def train_batch(self, batch) -> jax.Array:
-        """One optimizer step; grads stream to host per group and the
-        CPU-Adam update of group g overlaps the device backward of
-        group g-1.  With gradient_clipping the global norm needs every
-        grad before any update, so clipping trades the overlap away."""
+        """One optimizer step: ``gas`` micro-batches stream through the
+        mesh; grads partition back to their owning process; CPU-Adam
+        updates the local master slices.  Fast path (gas==1, no clip):
+        group g's host update overlaps the device backward of g-1."""
         if self._state is None:
             raise RuntimeError("call init_host() first")
-        # 0-based schedule step, matching the compiled path's state.step
         lr = self.lr_scheduler(self.step_count) \
             if callable(self.lr_scheduler) else self.config.optimizer.lr
         self._lr = float(jax.device_get(lr)) if hasattr(lr, "dtype") \
@@ -215,58 +336,113 @@ class ParamOffloadRunner:
         lr = self._lr
         self.step_count += 1
         clip = self.config.gradient_clipping
-        G = self.G
+        G, gas = self.G, self.gas
+        fast = gas == 1 and not clip
+        loc = self._g_master[0].size
 
-        # ---------------- forward (stream groups down) ----------------
-        acts = [self._jit_embed(self._shared_dev, batch)]
-        nxt = self._put_group(0)
-        for g in range(G):
-            cur, nxt = nxt, (self._put_group(g + 1) if g + 1 < G else None)
-            acts.append(self._jit_fwd(cur, acts[-1]))
-        loss, (g_sh_head, ct) = self._jit_head(self._shared_dev, acts[-1],
-                                               batch)
+        if not fast and self._g_hold is None:
+            self._g_hold = [self._alloc(f"hold{g}", loc) for g in range(G)]
+            self._sh_hold = self._alloc("hold_sh", self._sh_master.size)
+        if not fast:
+            for g in range(G):
+                self._g_hold[g][:] = 0.0
+            self._sh_hold[:] = 0.0
 
-        # ---------------- backward (stream groups up) ------------------
-        pending = None            # (g, host flat grads) awaiting update
-        held = []                 # clipping mode: all flats before updates
-        nxt = self._put_group(G - 1)
-        for g in range(G - 1, -1, -1):
-            cur, nxt = nxt, (self._put_group(g - 1) if g else None)
-            g_dev, ct = self._jit_bwd(cur, acts[g], ct)
-            if pending is not None and not clip:
-                self._host_update(*pending)      # overlaps device bwd
-            flat = np.concatenate([
-                _to_f32(l).ravel()
-                for l in jax.tree_util.tree_leaves(g_dev)])
-            pending = (g, flat)
+        micros = self._split_batch(batch, gas)
+        loss_acc = None
+        sq_acc = 0.0
+        for m, mb in enumerate(micros):
+            mb = self._shard_mb(mb)
+            # ---------------- forward (stream groups down) ------------
+            acts = [self._jit_embed(self._shared_dev, mb)]
+            nxt = self._put_group(0)
+            for g in range(G):
+                cur, nxt = nxt, (self._put_group(g + 1)
+                                 if g + 1 < G else None)
+                acts.append(self._jit_fwd(cur, acts[-1]))
+            loss, g_sh_head, ct = self._jit_head(self._shared_dev,
+                                                 acts[-1], mb)
+            loss_acc = loss if loss_acc is None else loss_acc + loss
+
+            # ---------------- backward (stream groups up) -------------
+            pending = None        # fast path: (g, flat) awaiting update
+            want_sq = bool(clip) and gas == 1
+            nxt = self._put_group(G - 1)
+            for g in range(G - 1, -1, -1):
+                cur, nxt = nxt, (self._put_group(g - 1) if g else None)
+                g_dev, ct, sq = self._jit_bwd(cur, acts[g], ct, want_sq)
+                if pending is not None:
+                    self._host_update(*pending)   # overlaps device bwd
+                flat = self._fetch_local(g_dev)
+                if want_sq:
+                    sq_acc += float(jax.device_get(sq))
+                if fast:
+                    pending = (g, flat)
+                else:
+                    self._g_hold[g] += flat
+            g_emb = self._jit_embed_bwd(self._shared_dev, mb, ct)
+            sh_flat = np.concatenate(
+                [_to_f32(a).ravel() + _to_f32(b).ravel()
+                 for a, b in zip(jax.tree_util.tree_leaves(g_sh_head),
+                                 jax.tree_util.tree_leaves(g_emb))])
+            if fast:
+                self._sh_grad = sh_flat
+            else:
+                self._sh_hold += sh_flat
+
+        # ---------------- update --------------------------------------
+        if fast:
+            if pending is not None:
+                self._host_update(*pending)
+            self._sh_opt.step(self._sh_master, self._sh_grad, lr=lr)
+        else:
+            inv = 1.0 / gas
+            sh = self._sh_hold
+            sh *= inv
+            scale = 1.0
             if clip:
-                held.append(pending)
-                pending = None
-        g_emb = self._jit_embed_bwd(self._shared_dev, batch, ct)
-        sh_flat = np.concatenate(
-            [_to_f32(a).ravel() + _to_f32(b).ravel()
-             for a, b in zip(jax.tree_util.tree_leaves(g_sh_head),
-                             jax.tree_util.tree_leaves(g_emb))])
+                if gas == 1:
+                    # exact: device-accumulated ‖g_group‖² (already
+                    # cross-shard psum'd; padding contributes zeros)
+                    groups_sq = sq_acc
+                else:
+                    # ‖Σ_m g_m‖² needs the accumulated grads: local dot
+                    # over the hold partitions + cross-process scalar sum
+                    from .. import comm
 
-        if clip:
-            # global-norm clip across ALL grads (engine _apply_grads parity)
-            sq = float(sh_flat.dot(sh_flat)) + sum(
-                float(f.dot(f)) for _, f in held)
-            norm = sq ** 0.5
-            if norm > clip:
-                s = clip / norm
-                sh_flat *= s
-                for _, f in held:
-                    f *= s
-            for g, f in held:
-                self._host_update(g, f)
-        elif pending is not None:
-            self._host_update(*pending)
-
-        # ---------------- shared update -------------------------------
-        self._sh_opt.step(self._sh_master, sh_flat, lr=lr)
+                    local = sum(float(h.dot(h)) * inv * inv
+                                for h in self._g_hold)
+                    groups_sq = float(comm.host_all_reduce_sum(local))
+                total_sq = (groups_sq * (inv * inv if gas == 1 else 1.0)
+                            + float(sh.dot(sh)))
+                norm = total_sq ** 0.5
+                if norm > clip:
+                    scale = clip / norm
+            for g in range(G):
+                buf = self._g_hold[g]
+                if inv != 1.0 or scale != 1.0:
+                    buf *= inv * scale
+                self._g_opt[g].step(self._g_master[g], buf, lr=lr)
+                self._refresh_mirror(g)
+            if scale != 1.0:
+                sh *= scale
+            self._sh_opt.step(self._sh_master, sh, lr=lr)
         self._shared_dev = self._place_shared()
-        return loss
+        return loss_acc / gas
+
+    def _split_batch(self, batch, gas: int):
+        if gas == 1:
+            return [batch]
+        leaves = jax.tree_util.tree_leaves(batch)
+        B = leaves[0].shape[0]
+        if B % gas:
+            raise ValueError(f"global batch {B} not divisible by "
+                             f"gradient_accumulation_steps {gas}")
+        mbs = []
+        for m in range(gas):
+            mbs.append(jax.tree_util.tree_map(
+                lambda x: x[m * (B // gas):(m + 1) * (B // gas)], batch))
+        return mbs
 
     def _host_update(self, g: int, flat: np.ndarray):
         self._g_opt[g].step(self._g_master[g], flat, lr=getattr(
@@ -278,7 +454,8 @@ class ParamOffloadRunner:
         """Forward-only loss with the same group streaming."""
         if self._state is None:
             raise RuntimeError("call init_host() first")
-        h = self._jit_embed(self._shared_dev, batch)
+        mb = self._shard_mb(batch)
+        h = self._jit_embed(self._shared_dev, mb)
         nxt = self._put_group(0)
         for g in range(self.G):
             cur, nxt = nxt, (self._put_group(g + 1)
@@ -286,32 +463,39 @@ class ParamOffloadRunner:
             h = self._jit_fwd(cur, h)
         if not hasattr(self, "_jit_loss"):
             self._jit_loss = jax.jit(self._loss_fn)
-        return self._jit_loss(self._shared_dev, h, batch)
+        return self._jit_loss(self._shared_dev, h, mb)
 
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state=None):
-        """Host state (fp32 masters + Adam moments + step) to one npz per
-        tag; a ``latest`` file mirrors the engine checkpoint layout."""
+        """Host state (fp32 master partitions + Adam moments + step) to
+        one npz PER PROCESS; a ``latest`` file mirrors the engine
+        checkpoint layout.  Restore requires the same mesh/process
+        topology (use ``host_params``/state_dict tools to re-partition)."""
         import pickle
 
         tag = tag or f"global_step{self.step_count}"
         d = os.path.join(save_dir, tag)
         os.makedirs(d, exist_ok=True)
-        arrs = {"client_state": np.frombuffer(
+        rank = jax.process_index()
+        arrs = {"ranges": np.asarray(self._ranges, np.int64),
+                "step": np.int64(self.step_count),
+                "t": np.int64(self._sh_opt.t)}
+        if rank == 0:
+            arrs.update({
+                "client_state": np.frombuffer(
                     pickle.dumps(client_state or {}), np.uint8),
                 "sh_master": self._sh_master,
                 "sh_m": self._sh_opt.exp_avg,
-                "sh_v": self._sh_opt.exp_avg_sq,
-                "step": np.int64(self.step_count),
-                "t": np.int64(self._sh_opt.t)}
+                "sh_v": self._sh_opt.exp_avg_sq})
         for g in range(self.G):
             arrs[f"g{g}_master"] = self._g_master[g]
             arrs[f"g{g}_m"] = self._g_opt[g].exp_avg
             arrs[f"g{g}_v"] = self._g_opt[g].exp_avg_sq
-        np.savez(os.path.join(d, "param_offload_state.npz"), **arrs)
-        with open(os.path.join(save_dir, "latest"), "w") as fh:
-            fh.write(tag)
+        np.savez(os.path.join(d, f"param_offload_rank{rank}.npz"), **arrs)
+        if rank == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as fh:
+                fh.write(tag)
         log_dist(f"param-offload checkpoint saved: {d}", ranks=[0])
         return d
 
@@ -321,10 +505,19 @@ class ParamOffloadRunner:
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as fh:
                 tag = fh.read().strip()
-        z = np.load(os.path.join(load_dir, tag, "param_offload_state.npz"))
-        self._sh_master[:] = z["sh_master"]
-        self._sh_opt.exp_avg[:] = z["sh_m"]
-        self._sh_opt.exp_avg_sq[:] = z["sh_v"]
+        rank = jax.process_index()
+        d = os.path.join(load_dir, tag)
+        z = np.load(os.path.join(d, f"param_offload_rank{rank}.npz"))
+        saved = [tuple(r) for r in z["ranges"]]
+        if saved != [tuple(r) for r in self._ranges]:
+            raise ValueError(
+                "param-offload checkpoint partition mismatch: saved "
+                f"{saved[:2]}… vs current {self._ranges[:2]}… — restore "
+                "on the same mesh topology")
+        z0 = np.load(os.path.join(d, "param_offload_rank0.npz"))
+        self._sh_master[:] = z0["sh_master"]
+        self._sh_opt.exp_avg[:] = z0["sh_m"]
+        self._sh_opt.exp_avg_sq[:] = z0["sh_v"]
         self.step_count = int(z["step"])
         self._sh_opt.t = int(z["t"])
         for g in range(self.G):
@@ -334,20 +527,32 @@ class ParamOffloadRunner:
             self._g_opt[g].t = int(z["t"])
             self._refresh_mirror(g)
         self._shared_dev = self._place_shared()
-        client = pickle.loads(z["client_state"].tobytes()) \
-            if "client_state" in z else {}
+        client = pickle.loads(z0["client_state"].tobytes()) \
+            if "client_state" in z0 else {}
         return load_dir, client
 
     # ------------------------------------------------------------------
     def host_params(self):
-        """Full fp32 master tree (host)."""
-        shared = self._unflatten(self._sh_master, self._sh_shapes,
-                                 self._sh_def, np.float32)
+        """Full fp32 master tree (host).  Single-process only — across
+        hosts each process holds 1/P of the flat masters; use the
+        per-rank checkpoints + state_dict tools to merge."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "host_params() gathers the full master: run it "
+                "single-process or merge the per-rank checkpoints")
+        shared = self._unflatten_np(self._sh_master, self._sh_shapes,
+                                    self._sh_def, np.float32)
         G, Lg = self.G, self._g_shapes[0][0]
         h_leaves = None
         for g in range(G):
-            leaves = jax.tree_util.tree_leaves(self._unflatten(
-                self._g_master[g], self._g_shapes, self._h_def, np.float32))
+            # local == global when single-process; strip padding
+            flat = np.empty(self._gsz_p, np.float32)
+            off = 0
+            for a, b in self._ranges:
+                flat[a:b] = self._g_master[g][off:off + (b - a)]
+                off += b - a
+            leaves = jax.tree_util.tree_leaves(self._unflatten_np(
+                flat[:self._gsz], self._g_shapes, self._h_def, np.float32))
             if h_leaves is None:
                 h_leaves = [[l] for l in leaves]
             else:

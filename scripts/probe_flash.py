@@ -31,22 +31,31 @@ def main():
     flops = 5 * B * H * S * S * D
 
     def run(bq, bk, G):
+        reps = 50   # one compiled scan: a single tunnel dispatch
+
         def f(q, k, v):
             def loss(q, k, v):
                 return flash_attention(
                     q, k, v, causal=True, block_q=bq, block_k=bk,
                     heads_per_program=G).astype(jnp.float32).sum()
-            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-            return l, grads
+
+            def body(carry, _):
+                l, grads = jax.value_and_grad(
+                    loss, argnums=(0, 1, 2))(q + carry.astype(q.dtype) * 0,
+                                             k, v)
+                # keep the backward LIVE: fold the grads into the carry
+                # (discarding them would let XLA dead-code the dq/dkv
+                # kernels and time forward-only)
+                g_sum = sum(g.astype(jnp.float32).sum() for g in grads)
+                return l + 0.0 * g_sum, None
+
+            l, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=reps)
+            return l
 
         jf = jax.jit(f)
-        out = jf(q, k, v)
-        jax.device_get(out[0])
-        reps = 20
+        jax.device_get(jf(q, k, v))
         t0 = time.perf_counter()
-        for _ in range(reps):
-            out = jf(q, k, v)
-        jax.device_get(out[0])
+        jax.device_get(jf(q, k, v))
         dt = (time.perf_counter() - t0) / reps
         return dt
 

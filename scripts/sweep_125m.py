@@ -1,10 +1,8 @@
 #!/usr/bin/env python
-"""One north-star (GPT-2-1.5B) config measurement per invocation.
-
-Usage: python scripts/sweep_northstar.py micro=4 gas=1 chunk=8192 \
-           save_logits=0 remat=dots_saveable steps=8
-Prints one JSON line; run sequentially from a shell loop for a sweep
-(fresh process per config keeps HBM fragmentation out of the numbers).
+"""One 125M-headline config measurement per invocation (mirrors
+bench_train's config).  Usage:
+  python scripts/sweep_125m.py micro=24 fb=1024x1024 save_logits=1
+Prints one JSON line.
 """
 import json
 import os
@@ -26,21 +24,20 @@ PEAK = 197e12
 
 def main():
     kv = dict(a.split("=", 1) for a in sys.argv[1:])
-    micro = int(kv.get("micro", 2))
-    gas = int(kv.get("gas", 1))
-    chunk = int(kv.get("chunk", 0))          # 0 = dense head
+    micro = int(kv.get("micro", 24))
+    chunk = int(kv.get("chunk", 1 << 30))
     save_logits = kv.get("save_logits", "0") == "1"
-    remat = kv.get("remat", "dots_saveable")  # "off" disables
+    remat = kv.get("remat", "off")
+    fb = kv.get("fb")
     steps = int(kv.get("steps", 8))
-    opt = kv.get("opt", "adamw8bit")
-    accum = kv.get("accum", "bf16" if gas > 1 else "fp32")
+    clip = float(kv.get("clip", 1.0))
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    preset = "gpt2-1.5b" if on_tpu else "gpt2-tiny"
+    preset = "gpt2-125m" if on_tpu else "gpt2-tiny"
     seq = SEQ if on_tpu else 128
 
-    fb = kv.get("fb")                        # e.g. fb=256x512
+    vocab = int(kv.get("vocab", 0))   # shrink the head to isolate its cost
     cfg = gpt2_config(
         preset, n_positions=seq, scan_layers=not on_tpu,
         remat=remat != "off",
@@ -48,41 +45,45 @@ def main():
         attn_impl=kv.get("attn", "auto"),
         flash_block=tuple(int(x) for x in fb.split("x")) if fb else None,
         loss_chunk=chunk or None, loss_save_logits=save_logits,
-        loss_pallas=kv.get("pl", "0") == "1")
+        loss_pallas=kv.get("pl", "0") == "1",
+        **({"vocab_size": vocab} if vocab else {}))
     model = GPT2LMHeadModel(cfg)
+    gas = int(kv.get("gas", 1))
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": gas,
-        "optimizer": {"type": opt,
+        "optimizer": {"type": kv.get("opt", "adamw"),
                       "params": {"lr": 1e-4, "weight_decay": 0.1}},
-        "zero_optimization": {"stage": 3},
-        "data_types": {"grad_accum_dtype": accum},
+        "gradient_clipping": clip,
+        "zero_optimization": {"stage": 1},
+        "data_types": {"grad_accum_dtype": kv.get("accum", "fp32")},
         "steps_per_print": 10**6,
     })
-    t_init = time.perf_counter()
     engine.init_params()
-    init_s = time.perf_counter() - t_init
     ids = np.random.default_rng(0).integers(
         0, cfg.vocab_size,
         size=(engine.train_batch_size, seq)).astype(np.int32)
     batch = engine.prepare_batch({"input_ids": ids, "labels": ids})
-    t_c = time.perf_counter()
     losses = engine.train_batches(batch, steps=steps, stacked=False)
     jax.device_get(losses)
-    compile_s = time.perf_counter() - t_c
-    t0 = time.perf_counter()
-    losses = engine.train_batches(batch, steps=steps, stacked=False)
-    jax.device_get(losses)
-    dt = time.perf_counter() - t0
-    tok_s = engine.train_batch_size * seq * steps / dt
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        losses = engine.train_batches(batch, steps=steps, stacked=False)
+        jax.device_get(losses)
+        windows.append(engine.train_batch_size * seq * steps
+                       / (time.perf_counter() - t0))
+    import statistics
+
+    tok_s = statistics.median(windows)
     mfu = tok_s * model.flops_per_token() / (PEAK if on_tpu else 1e12)
     print(json.dumps({
         "config": {"micro": micro, "gas": gas, "chunk": chunk,
-                   "save_logits": save_logits, "remat": remat, "opt": opt},
+                   "save_logits": save_logits, "remat": remat, "fb": fb,
+                   "clip": clip},
         "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
         "vs_ref": round(mfu / REF_MFU, 3),
-        "step_ms": round(1000 * dt / steps, 1),
-        "init_s": round(init_s, 1), "compile_s": round(compile_s, 1),
+        "windows": [round(w, 1) for w in windows],
         "final_loss": float(jax.device_get(losses)[-1]),
     }), flush=True)
 

@@ -99,8 +99,13 @@ def test_autotuner_flash_knobs_probed_and_carried():
         cfg = tuner.tune()
         assert all(r.error is None for r in tuner.results), \
             [r.error for r in tuner.results]
-        assert cfg["model_overrides"] in (
+        # model_overrides carry the winning kernel knob AND the remat
+        # flag (tune() pins remat both directions since round 3)
+        mo_kernel = {k: v for k, v in cfg["model_overrides"].items()
+                     if k != "remat"}
+        assert mo_kernel in (
             {"flash_block": (256, 256)}, {"flash_heads_per_program": 2})
+        assert cfg["model_overrides"]["remat"] is False
         # the override reconfigures the model when fed back to initialize()
         import deepspeed_tpu
 
@@ -137,3 +142,42 @@ def test_model_overrides_applied_by_engine():
         assert engine.model.cfg.fused_mlp is True
     finally:
         mesh_mod.set_mesh(None)
+
+
+def test_northstar_space_probes_and_picks():
+    """Round-2 verdict item 8: the billion-param single-chip recipe
+    (ZeRO-3, micro, remat policy, loss_chunk, adamw8bit, scan_layers) is
+    a machine-searchable space, not BENCH_NORTHSTAR prose.  At tiny
+    scale everything fits; the point is that all dimensions probe
+    cleanly and the winner round-trips through initialize()."""
+    import deepspeed_tpu
+
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=False,
+                                        n_layer=2))
+    tuner = Autotuner.northstar_space(
+        model,
+        base_config={"optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                     "steps_per_print": 10**9},
+        micro_batches=[1, 2],
+        remat_options=[False],
+        kernel_options=[{"scan_layers": False, "loss_chunk": None},
+                        {"scan_layers": False, "loss_chunk": 64}],
+        seq_len=32)
+    best = tuner.tune()
+    probes = [r for r in tuner.results if not r.error]
+    assert probes, [r.error for r in tuner.results]
+    # both optimizer variants probed
+    opts = {r.config_overrides["optimizer"].get("type")
+            for r in tuner.results}
+    assert opts == {"adamw8bit", "adamw"}
+    assert best["zero_optimization"]["stage"] == 3
+    assert best["optimizer"]["type"] in ("adamw8bit", "adamw")
+    # winner config drives a real engine (autotuned recipe is runnable)
+    mesh_mod.set_mesh(None)
+    best.pop("autotuned")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=best)
+    engine.init_params()
+    batch = engine.model.dummy_inputs(batch_size=engine.train_batch_size,
+                                      seq_len=32)
+    loss = engine.train_batch(batch)
+    assert np.isfinite(float(jax.device_get(loss)))

@@ -1,0 +1,181 @@
+"""Engine-executed 1-bit Adam with the packed compressed collective
+(runtime/onebit_comm.py; reference onebit/adam.py:14 + comm/nccl.py:52,
+perf harness tests/onebit/test_nccl_perf.py).  Round-2 verdict item 7:
+the comm-bytes reduction must be demonstrated through the engine."""
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+from .simple_model import token_batch
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _engine(opt_params, opt_type="onebitadam"):
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": opt_type, "params": opt_params},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"dp": 8},
+        "steps_per_print": 10**6,
+    })
+    engine.init_params()
+    return engine
+
+
+def test_packed_allreduce_matches_unpacked():
+    """The uint8-packed wire format computes the same sum as the fp32
+    sign-compressed psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.onebit import (compressed_all_reduce,
+                                          compressed_all_reduce_packed)
+
+    mesh = mesh_mod.build_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 37, 5)).astype(np.float32)
+    e = rng.normal(size=(8, 37, 5)).astype(np.float32) * 0.1
+
+    def run(fn):
+        def local(x, e):
+            tot, ne = fn(x[0], e[0], ("dp",))
+            return tot, ne[None]
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(P("dp"), P("dp")),
+            out_specs=(P(), P("dp")), check_vma=False)(x, e)
+
+    t1, e1 = run(compressed_all_reduce)
+    t2, e2 = run(compressed_all_reduce_packed)
+    # psum tree-reduction vs einsum summation order: ~1e-5 relative
+    np.testing.assert_allclose(np.asarray(t1), np.asarray(t2),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_onebit_warmup_matches_dense_adam():
+    """During warmup (count <= freeze_step) the 1-bit engine path IS
+    exact Adam with dense reduction — trajectories must agree."""
+    ob = _engine({"lr": 1e-3, "weight_decay": 0.0, "freeze_step": 1000,
+                  "comm_backend": "compressed"})
+    batch = token_batch(ob.train_batch_size, 32, 512, seed=0)
+    l_ob = [float(ob.train_batch(batch)) for _ in range(3)]
+
+    mesh_mod.set_mesh(None)
+    ref = _engine({"lr": 1e-3, "weight_decay": 0.0}, opt_type="adam")
+    l_ref = [float(ref.train_batch(batch)) for _ in range(3)]
+    np.testing.assert_allclose(l_ob, l_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onebit_compressed_stage_trains():
+    """Past the freeze step the packed-momentum path keeps training
+    (error feedback preserves convergence on a memorizing batch)."""
+    eng = _engine({"lr": 1e-3, "weight_decay": 0.0, "freeze_step": 2,
+                   "comm_backend": "compressed"})
+    batch = token_batch(eng.train_batch_size, 32, 512, seed=1)
+    losses = [float(eng.train_batch(batch)) for _ in range(12)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[2]     # keeps learning after the freeze
+
+
+_SIZES = {"f32": 4, "f16": 2, "bf16": 2, "f64": 8,
+          "i32": 4, "ui32": 4, "i8": 1, "ui8": 1, "i1": 1}
+
+
+def _collective_bytes(stablehlo: str) -> int:
+    """Sum result-tensor bytes of every explicit collective in a lowered
+    StableHLO dump (shard_map collectives appear as stablehlo.all_reduce
+    / all_gather / reduce_scatter ops; GSPMD-era implicit reductions do
+    not exist on this path — both comparands use explicit shard_map)."""
+    total = 0
+    # all_reduce carries a multi-line reduction region before its type
+    # signature — match lazily across lines to the first result type
+    for m in re.finditer(
+            r"stablehlo\.(?:all_reduce|all_gather|reduce_scatter)"
+            r".*?->\s*tensor<((?:\d+x)*)(\w+)>", stablehlo, re.S):
+        dims, dt = m.group(1), m.group(2)
+        if dt not in _SIZES:
+            continue
+        n = 1
+        for d in dims.split("x"):
+            if d:
+                n *= int(d)
+        total += n * _SIZES[dt]
+    return total
+
+
+def test_onebit_comm_bytes_reduced():
+    """THE claim (reference README.md:40 '26x'): the compressed stage's
+    per-step collective traffic must be a small fraction of the dense
+    wire format.  Same algorithm both sides (sign compression + error
+    feedback); only the WIRE FORMAT differs — packed uint8 bits vs fp32
+    sign tensors (dense-gradient byte cost).  freeze_step=0 lowers the
+    compressed stage alone, so the comparison is clean."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime import onebit_comm as obc
+
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "onebitadam",
+                      "params": {"lr": 1e-3, "freeze_step": 0,
+                                 "comm_backend": "compressed"}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"dp": 8},
+        "steps_per_print": 10**6,
+    })
+    engine.init_params()
+    batch = engine._shard_batch(
+        token_batch(engine.train_batch_size, 32, 512, seed=2))
+    rng = jax.random.PRNGKey(0)
+
+    def lowered_bytes(packed):
+        step = obc.step_factory(
+            engine.mesh,
+            lambda p, b, r: engine._loss_fn(p, b, r, deterministic=False),
+            engine.lr_scheduler, b1=0.9, b2=0.999, eps=1e-8,
+            weight_decay=0.0, freeze_step=0, packed=packed)
+        txt = jax.jit(step).lower(
+            engine.state.params, engine.state.opt_state, batch, rng
+        ).as_text()
+        return _collective_bytes(txt)
+
+    b_packed, b_dense = lowered_bytes(True), lowered_bytes(False)
+    assert b_packed > 0 and b_dense > 0
+    # counting convention: RESULT bytes of each collective.  Packed:
+    # uint8 sign bits — the W-fold gather output is W·N/8 = N bytes at
+    # W=8; dense: fp32 all_reduce results, 4N.  That caps this metric at
+    # 4× (scalars nudge it just under); the PER-HOP wire bytes are
+    # N/8 vs 4N = 32× — the reference's 1-bit claim
+    assert b_packed < b_dense / 3, (b_packed, b_dense)
+    # and the packed path's collectives are (almost) all uint8
+    assert b_packed < 0.26 * b_dense
+
+
+def test_onebit_comm_validation():
+    with pytest.raises(NotImplementedError, match="zero stage 0"):
+        model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", scan_layers=True))
+        deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "onebitadam",
+                          "params": {"lr": 1e-3,
+                                     "comm_backend": "compressed"}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"dp": 8},
+        })
