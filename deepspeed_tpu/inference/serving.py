@@ -75,6 +75,15 @@ class ContinuousBatcher:
                           or cfg.vocab_size)
 
         cache1 = engine.init_cache(1)
+        # per-leaf batch axis of the engine cache (scan-stacked layers put
+        # batch at dim 1, plain stacks at dim 0, cache_index is a scalar):
+        # diff the abstract shapes of a 1-row vs 2-row cache
+        c1_sds = jax.eval_shape(lambda: engine.init_cache(1))
+        c2_sds = jax.eval_shape(lambda: engine.init_cache(2))
+        self._cache_bdims = jax.tree_util.tree_map(
+            lambda a, b: next((d for d in range(len(a.shape))
+                               if a.shape[d] != b.shape[d]), None),
+            c1_sds, c2_sds)
         self._cache = jax.tree_util.tree_map(
             lambda l: jnp.broadcast_to(l, (n_slots,) + l.shape) + jnp.zeros_like(l),
             cache1)
@@ -226,16 +235,18 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------
     def _prefill(self, ids):
-        """B=1 prefill of the whole prompt into a fresh cache.
+        """Prefill of ``ids`` (B, S) — B prompts of equal length — into a
+        fresh B-row cache.
 
         ``chunked_prefill`` feeds the prompt as DESCENDING power-of-two
         chunks (the binary decomposition of its length), so across every
         prompt length the compile cache holds at most log2(max_len)
-        prefill executables instead of one per distinct length — each
-        chunk appends at its exact positions, so the cache stays exact
-        (no pad pollution).  Returns (last-chunk logits, cache)."""
+        prefill executables per batch width instead of one per distinct
+        length — each chunk appends at its exact positions, so the cache
+        stays exact (no pad pollution).  Returns (last-chunk logits,
+        cache)."""
         eng = self.engine
-        cache = eng.init_cache(1)
+        cache = eng.init_cache(ids.shape[0])
         S = ids.shape[1]
         if not self.chunked_prefill:
             return eng._compiled_prefill(eng.params, cache, ids,
@@ -254,31 +265,47 @@ class ContinuousBatcher:
         return logits, cache
 
     def _admit(self):
-        eng = self.engine
-        for i in range(self.n_slots):
-            if not self._queue or self._slots[i] is not None:
-                continue
-            req = self._queue.popleft()
-            ids = jnp.asarray(req.prompt)[None, :]
-            logits, cache1 = self._prefill(ids)
-            # fixed shapes only reach the jitted admission: the last-token
-            # logits row and a HOST-built (1, V) prompt mask — so it
-            # compiles exactly once across all prompt lengths
-            prompt_seen = np.zeros((1, self._vocab), bool)
-            prompt_seen[0, req.prompt] = True
-            (self._cache, self._token, self._pos, self._temp, self._top_p,
-             self._rep, self._seen, self._done, first) = self._admit_fn(
-                self._cache, self._token, self._pos, self._temp,
-                self._top_p, self._rep, self._seen, self._done,
-                cache1, logits[:, -1, :], jnp.asarray(prompt_seen),
-                len(req.prompt), req.uid, i,
-                req.temperature, req.top_p, req.repetition_penalty)
-            first_host = int(jax.device_get(first)[0])
-            self._t_first[req.uid] = time.perf_counter()
-            done0 = first_host == self.eos or req.max_new_tokens <= 1
-            self._slots[i] = _Active(req, [first_host])
-            if done0:
-                self._retire(i)
+        """Admit queued requests into free slots.  Same-length prompts at
+        the queue head share ONE batched prefill (one compiled forward at
+        (B, chunk) instead of B serial B=1 prefills), so a burst of
+        arrivals no longer stacks k prefills onto the k-th TTFT — the
+        round-2 serial-admission weakness."""
+        free = [i for i in range(self.n_slots) if self._slots[i] is None]
+        while self._queue and free:
+            plen = len(self._queue[0].prompt)
+            reqs = [self._queue.popleft()]
+            while (self._queue and len(reqs) < len(free)
+                   and len(self._queue[0].prompt) == plen):
+                reqs.append(self._queue.popleft())
+            ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
+            logits, cacheB = self._prefill(ids)
+            for row, req in enumerate(reqs):
+                i = free.pop(0)
+                cache1 = jax.tree_util.tree_map(
+                    lambda l, bd: l if bd is None
+                    else jax.lax.dynamic_slice_in_dim(l, row, 1, bd),
+                    cacheB, self._cache_bdims)
+                # fixed shapes only reach the jitted admission: the
+                # last-token logits row and a HOST-built (1, V) prompt
+                # mask — so it compiles once across all prompt lengths
+                prompt_seen = np.zeros((1, self._vocab), bool)
+                prompt_seen[0, req.prompt] = True
+                (self._cache, self._token, self._pos, self._temp,
+                 self._top_p, self._rep, self._seen, self._done,
+                 first) = self._admit_fn(
+                    self._cache, self._token, self._pos, self._temp,
+                    self._top_p, self._rep, self._seen, self._done,
+                    cache1, logits[row:row + 1, -1, :],
+                    jnp.asarray(prompt_seen),
+                    len(req.prompt), req.uid, i,
+                    req.temperature, req.top_p, req.repetition_penalty)
+                first_host = int(jax.device_get(first)[0])
+                self._t_first[req.uid] = time.perf_counter()
+                done0 = first_host == self.eos or req.max_new_tokens <= 1
+                self._slots[i] = _Active(req, [first_host])
+                if done0:
+                    self._retire(i)
+                    free.append(i)
 
     def _retire(self, i: int):
         act = self._slots[i]

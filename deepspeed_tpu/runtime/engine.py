@@ -918,6 +918,12 @@ class Engine:
         key = (steps, stacked)
         if key not in cache:
             body = self._train_step_body
+            # scan unroll lets XLA software-pipeline across optimizer-step
+            # boundaries (step k's trailing updates overlap step k+1's
+            # leading forward) at unroll× compile cost; probe knob
+            import os as _os
+
+            unroll = int(_os.environ.get("DS_TPU_MULTISTEP_UNROLL", "1"))
 
             def multi(state: TrainState, batch):
                 def scan_body(st, mb):
@@ -926,7 +932,8 @@ class Engine:
 
                 return jax.lax.scan(scan_body, state,
                                     batch if stacked else None,
-                                    length=steps)
+                                    length=steps,
+                                    unroll=min(unroll, steps))
 
             cache[key] = jax.jit(
                 multi, donate_argnums=(0,),

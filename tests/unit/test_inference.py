@@ -329,3 +329,29 @@ def test_continuous_batcher_idle_and_immediate_finish():
     assert b.pending == 0
     with pytest.raises(ValueError):
         b.step(ticks=0)
+
+
+def test_continuous_batcher_batched_admission_exact():
+    """A burst of SAME-LENGTH prompts shares one batched prefill
+    (round-3 admission path); outputs must match single-request runs
+    exactly, and mixed lengths fall back per-group."""
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    rng = np.random.default_rng(21)
+    same = [rng.integers(0, 512, size=(8,)).astype(np.int32)
+            for _ in range(4)]
+    singles = [np.asarray(eng.generate(p[None], max_new_tokens=5))[0]
+               for p in same]
+    batcher = ContinuousBatcher(eng, n_slots=4)
+    outs = batcher.run(same, max_new_tokens=5)
+    for got, want in zip(outs, singles):
+        np.testing.assert_array_equal(got, want)
+    # mixed lengths: 8,8 batch together, 5 admits alone — still exact
+    mixed = [same[0], same[1],
+             rng.integers(0, 512, size=(5,)).astype(np.int32)]
+    singles_m = [np.asarray(eng.generate(p[None], max_new_tokens=4))[0]
+                 for p in mixed]
+    b2 = ContinuousBatcher(eng, n_slots=4)
+    outs_m = b2.run(mixed, max_new_tokens=4)
+    for got, want in zip(outs_m, singles_m):
+        np.testing.assert_array_equal(got, want)
