@@ -53,6 +53,27 @@ def _fence(x):
     jax.device_get(x)
 
 
+def _retry(fn, label: str, attempts: int = 3, backoff_s: float = 3.0):
+    """Run ``fn`` with retries against transient tunnel failures.
+
+    The remote-compile tunnel to the bench chip occasionally drops a
+    response mid-body (``INTERNAL: .../remote_compile: read body:
+    response body closed``) — that one flake erased the whole official
+    round-3 record.  Retries are cheap: the XLA compile cache makes a
+    repeat call skip straight to execution.  Backs off between tries
+    (the tunnel usually recovers within seconds)."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:          # noqa: BLE001 — tunnel faults
+            last = e                    # surface as JaxRuntimeError etc.
+            print(f"# bench retry [{label}] {i + 1}/{attempts}: "
+                  f"{repr(e)[:200]}", file=sys.stderr, flush=True)
+            time.sleep(backoff_s * (i + 1))
+    raise last
+
+
 def bench_decode():
     """``bench.py --mode decode``: batched decode throughput (tokens/s)
     through the continuous batcher — the serving analog of the training
@@ -268,15 +289,26 @@ def bench_northstar(steps: int = 8):
     # warm with the SAME steps count (the scan length is baked into the
     # compiled program — a different count would put the compile inside
     # the timed window)
-    losses = engine.train_batches(batch, steps=steps)
-    _fence(losses)
-    t0 = time.perf_counter()
-    losses = engine.train_batches(batch, steps=steps)
-    _fence(losses)
-    dt = time.perf_counter() - t0
+    def measure():
+        losses = engine.train_batches(batch, steps=steps)
+        _fence(losses)
+        t0 = time.perf_counter()
+        losses = engine.train_batches(batch, steps=steps)
+        _fence(losses)
+        return losses, time.perf_counter() - t0
+
+    losses, dt = _retry(measure, "northstar-1p5b")
     loss = losses[-1]
     tok_s = engine.train_batch_size * seq * steps / dt
+    final_loss = float(jax.device_get(loss))
     mfu = tok_s * model.flops_per_token() / _peak(dev)
+    # free the 1.5B state (params fp32 + int8 moments ≈ 9.5 GB) before
+    # the serving block — round-4 anchor run OOM'd serving otherwise
+    engine._state = None
+    del engine, batch, losses, loss, measure
+    import gc
+
+    gc.collect()
     return {
         "metric": f"{preset} train tokens/sec/chip "
                   f"(seq {seq}, zero3, adamw8bit, bf16)",
@@ -284,7 +316,7 @@ def bench_northstar(steps: int = 8):
         "vs_baseline": round(mfu / REF_MFU, 3),
         "mfu": round(mfu, 4),
         "step_ms": round(1000 * dt / steps, 1),
-        "final_loss": float(__import__("jax").device_get(loss)),
+        "final_loss": final_loss,
     }
 
 
@@ -350,16 +382,44 @@ def bench_train():
     # Warm-up MUST use the same step count: the multi-step program is
     # compiled per `steps`.
     steps = 8
-    losses = engine.train_batches(batch, steps=steps)   # compile + warm
-    _fence(losses)
-    windows = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        losses = engine.train_batches(batch, steps=steps)
+    degraded = False
+
+    def measure_multistep():
+        losses = engine.train_batches(batch, steps=steps)  # compile + warm
         _fence(losses)
-        windows.append(engine.train_batch_size * seq * steps
-                       / (time.perf_counter() - t0))
-    loss = losses[-1]
+        wins = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            losses = engine.train_batches(batch, steps=steps)
+            _fence(losses)
+            wins.append(engine.train_batch_size * seq * steps
+                        / (time.perf_counter() - t0))
+        return wins, losses[-1]
+
+    def measure_per_step():
+        # Degraded fallback if the multi-step path keeps dying on the
+        # tunnel: time `steps` individual train_batch dispatches.  Each
+        # dispatch eats ~5 ms tunnel RTT the scan would amortize, so the
+        # record is marked "degraded" — slower, but never absent.
+        loss = engine.train_batch(batch)                   # compile + warm
+        _fence(loss)
+        wins = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch(batch)
+            _fence(loss)
+            wins.append(engine.train_batch_size * seq * steps
+                        / (time.perf_counter() - t0))
+        return wins, loss
+
+    try:
+        windows, loss = _retry(measure_multistep, "headline-multistep")
+    except Exception as e:  # noqa: BLE001
+        print(f"# headline multi-step failed after retries; per-step "
+              f"fallback: {repr(e)[:200]}", file=sys.stderr, flush=True)
+        degraded = True
+        windows, loss = _retry(measure_per_step, "headline-per-step")
     os.environ.pop("DS_TPU_MULTISTEP_UNROLL", None)  # 1.5B block: unroll 1
     tokens_per_sec = statistics.median(windows)
     mfu = tokens_per_sec * model.flops_per_token() / peak
@@ -373,6 +433,17 @@ def bench_train():
                   "final_loss": float(jax.device_get(loss)),
                   "windows_tok_s": [round(w, 1) for w in windows]},
     }
+    if degraded:
+        result["extra"]["degraded"] = True
+    # release the 125M engine before the 1.5B/serving extras: its fp32
+    # state (~1.5 GB) otherwise stays live under them on the 16 GB chip
+    # (the round-4 anchor run OOM'd the serving block exactly this way)
+    engine._state = None
+    # the measure closures hold the engine in cells — drop them too
+    del engine, batch, loss, measure_multistep, measure_per_step
+    import gc
+
+    gc.collect()
 
     if not os.environ.get("DS_TPU_BENCH_SKIP_1P5B"):
         try:
@@ -401,7 +472,18 @@ def main():
     if cli.mode == "serving":
         print(json.dumps(bench_serving()), flush=True)
         return
-    return bench_train()
+    try:
+        return bench_train()
+    except Exception as e:  # noqa: BLE001
+        # Last resort: the driver records ONE JSON line per round; a bare
+        # traceback erases the whole record (round 3).  Emit a diagnosable
+        # line first, then fail loudly.
+        print(json.dumps({
+            "metric": f"{MODEL} train tokens/sec/chip (seq {SEQ}, "
+                      "zero1, bf16)",
+            "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "extra": {"error": repr(e)[:400]}}), flush=True)
+        raise
 
 
 if __name__ == "__main__":

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
-from .common import ModelOutput, cross_entropy_loss
+from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,7 +217,7 @@ class BertModel(nn.Module):
         layer_cls = BertLayer
         if cfg.remat:
             layer_cls = nn.remat(BertLayer,
-                                 policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                                 policy=resolve_remat_policy(cfg.remat_policy),
                                  prevent_cse=False)
         if cfg.scan_layers:
             stack = nn.scan(layer_cls,

@@ -51,6 +51,30 @@ def logical_to_mesh_axes(logical_names: tuple, rules: dict) -> P:
     return P(*(rules.get(name) for name in logical_names))
 
 
+def resolve_remat_policy(name: str):
+    """Config remat-policy name → ``jax.checkpoint_policies`` callable.
+
+    Beyond the stock names, ``"<base>+flash"`` combines the base policy
+    with saving the flash-attention kernel's named residuals
+    (``flash_out`` / ``flash_lse``): pallas outputs are not dot outputs,
+    so every dot-based policy discards them and remat re-runs the whole
+    forward kernel inside each backward — "+flash" trades that recompute
+    for O(B·S·E) bf16 of saved activations per layer."""
+    base, plus, extra = name.partition("+")
+    cp = jax.checkpoint_policies
+    pol = getattr(cp, base, None)
+    if pol is None:
+        raise ValueError(f"unknown remat policy {base!r}; see "
+                         "jax.checkpoint_policies")
+    if plus:
+        if extra != "flash":
+            raise ValueError(f"unknown remat policy suffix {extra!r} in "
+                             f"{name!r} (supported: '+flash')")
+        pol = cp.save_from_both_policies(
+            pol, cp.save_only_these_names("flash_out", "flash_lse"))
+    return pol
+
+
 def param_with_axes(init_fn, names: tuple):
     """Box an initializer with logical partition names (flax metadata)."""
     return nn.with_partitioning(init_fn, names)
@@ -213,7 +237,12 @@ def pallas_lm_loss(h: jax.Array, wte: jax.Array, labels: jax.Array, *,
 
     B, S, E = h.shape
     N = B * S
-    bq = min(bq, N)
+    # Mosaic lane alignment: the (1,1,bq) block layout needs bq to be a
+    # multiple of 128.  Shrink toward N for tiny batches but keep the
+    # 128 floor — padded rows carry ignore_index, so over-padding is
+    # exact (it only adds masked rows).
+    bq = max(128, min(bq, -(-N // 128) * 128))
+    bq -= bq % 128
     hf = h.reshape(N, E)
     tf = labels.reshape(N)
     pad = (-N) % bq

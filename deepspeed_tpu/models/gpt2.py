@@ -21,9 +21,10 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.attention import dot_product_attention, on_tpu
-from .common import ModelOutput, cross_entropy_loss, shift_labels
+from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift_labels
 
 
 @dataclasses.dataclass(frozen=True)
@@ -363,7 +364,7 @@ class GPT2LMHeadModel(nn.Module):
             block_cls = Block
             if cfg.remat:
                 block_cls = nn.remat(
-                    Block, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                    Block, policy=resolve_remat_policy(cfg.remat_policy),
                     prevent_cse=False, static_argnums=())
             stack = nn.scan(
                 block_cls,
@@ -383,7 +384,7 @@ class GPT2LMHeadModel(nn.Module):
                 block_cls = Block
                 if cfg.remat:
                     block_cls = nn.remat(
-                        Block, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                        Block, policy=resolve_remat_policy(cfg.remat_policy),
                         prevent_cse=False)
                 h, aux = block_cls(cfg, deterministic, name=f"h_{i}")(
                     h, (mask, layer_drop_theta))
@@ -396,8 +397,18 @@ class GPT2LMHeadModel(nn.Module):
             from .common import chunked_lm_loss, pallas_lm_loss
 
             tgt = shift_labels(labels) if shift else labels
-            if cfg.loss_pallas and on_tpu() and \
-                    _ce_supported(cfg.padded_vocab_size):
+            # pallas CE has no shard_map wrapper: its (E,Vp) dw reduction
+            # would replicate on a sharded mesh.  Same dispatch contract
+            # as _flash_spmd — "direct" (single device) only, else the
+            # SPMD-safe chunked XLA head.
+            use_pallas_ce = (cfg.loss_pallas and on_tpu()
+                             and _ce_supported(cfg.padded_vocab_size))
+            if use_pallas_ce:
+                from ..ops.pallas.spmd import kernel_mesh_plan
+
+                verdict, _ = kernel_mesh_plan(h.shape[0])
+                use_pallas_ce = verdict == "direct"
+            if use_pallas_ce:
                 loss = pallas_lm_loss(
                     h, wte, tgt, vocab_size=cfg.vocab_size,
                     padded_vocab_size=cfg.padded_vocab_size,
@@ -432,12 +443,47 @@ class GPT2LMHeadModel(nn.Module):
 
     # -- pipeline decomposition (parallel/pipeline.py contract) --------
     @nn.nowrap
-    def pipeline_fns(self, n_stages: int):
+    def pipeline_layout(self, n_stages: int, method: str = "uniform"):
+        """Layer→stage placement (reference ``pipe/module.py:363``
+        ``_partition_layers``).  ``method='parameters'`` balances the
+        homogeneous block weights against the embed load on stage 0 and
+        the tied E×V head load on the last stage; ``type:<regex>``
+        weighs layers whose type name matches."""
+        from ..parallel.partition import make_layout
+
+        cfg = self.cfg
+        block_w = float(12 * cfg.n_embd ** 2 + 13 * cfg.n_embd)
+        extras = [0.0] * n_stages
+        extras[0] += float((cfg.padded_vocab_size + cfg.n_positions)
+                           * cfg.n_embd)              # wte + wpe
+        extras[-1] += float(cfg.padded_vocab_size * cfg.n_embd)  # tied head
+        return make_layout(
+            cfg.n_layer, n_stages, method,
+            layer_weights=[block_w] * cfg.n_layer,
+            layer_types=["Block"] * cfg.n_layer,
+            stage_extras=extras if method == "parameters" else None)
+
+    @nn.nowrap
+    def pipeline_fns(self, n_stages: int, method: str = "uniform"):
         """Split the forward pass into (embed, stage, loss) closures.
 
         The stage function re-binds the same scanned ``Block`` stack over a
         ``n_layer/n_stages``-slice of the ``h`` params, so PP reuses the
         exact single-path math (no drift between PP and non-PP).
+
+        Heterogeneous/balanced partitioning (reference pipe/module.py:363
+        ``partition_layers``): n_layer need not divide n_stages, and
+        ``method`` picks the placement (see :meth:`pipeline_layout`).  The
+        stack is zero-PADDED to ``local·n_stages`` slots — a zero-weight
+        pre-LN block is an exact identity (both residual branches end in
+        a zero-weight projection, so forward adds 0 and the cotangent
+        through the branch is 0).  ``split_params`` pads+places a
+        canonical stack (idempotent: an already-stored stack passes
+        through) and ``merge_params`` inverts it; the engine stores the
+        stack in placed order so neither costs anything per step.  With a
+        non-trivial placement the stage executor cond-gates each slot on
+        its real-layer count, so a stage whose slack is pad slots SKIPS
+        that compute at run time (the balancing actually lands).
         """
         cfg = self.cfg
         if not cfg.scan_layers:
@@ -446,20 +492,11 @@ class GPT2LMHeadModel(nn.Module):
             raise NotImplementedError(
                 "MoE + pipeline parallelism: the aux loss does not flow "
                 "through the pipeline loop yet; use ep with dp/fsdp/tp")
-        # Heterogeneous partitioning (reference pipe/module.py:363
-        # ``partition_layers`` uniform/param-count balancing): n_layer need
-        # not divide n_stages.  The stack is zero-PADDED to
-        # ceil(L/stages)·stages inside ``split_params`` — a zero-weight
-        # pre-LN block is an exact identity (both residual branches end in
-        # a zero-weight projection, so forward adds 0 and the cotangent
-        # through the branch is 0) — and ``merge_params`` slices grads
-        # back to the canonical L layers, so pad slots are re-created zero
-        # every step and can never drift.  For a homogeneous scanned stack
-        # "balance by params" degenerates to this uniform ceil split; the
-        # ≤ stages-1 pad layers cost their compute on the last stage.
-        local_layers = -(-cfg.n_layer // n_stages)          # ceil
-        padded_layers = local_layers * n_stages
+        layout = self.pipeline_layout(n_stages, method)
+        local_layers = layout.local_layers
+        padded_layers = layout.padded_layers
         n_pad = padded_layers - cfg.n_layer
+        trivial = layout.trivial
 
         stage_stack = nn.scan(
             Block,
@@ -474,17 +511,17 @@ class GPT2LMHeadModel(nn.Module):
         def split_params(params):
             shared = {k: v for k, v in params.items() if k != "h"}
             stage = params["h"]
-            if n_pad:
-                stage = jax.tree_util.tree_map(
-                    lambda l: jnp.concatenate(
-                        [l, jnp.zeros((n_pad,) + l.shape[1:], l.dtype)]),
-                    stage)
+            shape = np.shape(jax.tree_util.tree_leaves(stage)[0])
+            lead = shape[0] if shape else None
+            if lead == cfg.n_layer and (n_pad or not trivial):
+                stage = jax.tree_util.tree_map(layout.place, stage)
             return shared, stage
 
-        def merge_params(shared, stage):
-            if n_pad:
-                stage = jax.tree_util.tree_map(lambda l: l[:cfg.n_layer],
-                                               stage)
+        def merge_params(shared, stage, keep_layout: bool = False):
+            shape = np.shape(jax.tree_util.tree_leaves(stage)[0])
+            lead = shape[0] if shape else None
+            if not keep_layout and lead == padded_layers != cfg.n_layer:
+                stage = jax.tree_util.tree_map(layout.unplace, stage)
             return {**shared, "h": stage}
 
         def embed_fn(shared, mb):
@@ -494,9 +531,40 @@ class GPT2LMHeadModel(nn.Module):
             return (shared["wte"].astype(cfg.dtype)[ids]
                     + shared["wpe"].astype(cfg.dtype)[pos])
 
-        def stage_fn(stage_params, h):
-            h, _ = stage_stack.apply({"params": stage_params}, h, None)
-            return h
+        if trivial:
+            def stage_fn(stage_params, h):
+                h, _ = stage_stack.apply({"params": stage_params}, h, None)
+                return h
+        else:
+            # placed layout: cond-gate each local slot on this stage's
+            # real-layer count so pad slots SKIP their compute at run
+            # time (lax.cond executes one branch; reverse-differentiable,
+            # unlike a dynamic-bound fori_loop).  Must run under the
+            # manual ``pp`` shard_map (the pipeline loops' contract).
+            block = Block(cfg, True)
+            counts = tuple(layout.stage_counts())
+
+            def stage_fn(stage_params, h, chunk_slot=None):
+                sid = jax.lax.axis_index("pp")
+                g = sid if chunk_slot is None \
+                    else chunk_slot * jax.lax.axis_size("pp") + sid
+                n_real = jnp.asarray(counts, jnp.int32)[g]
+
+                def body(carry, xs):
+                    v, params_v = xs
+
+                    def run():
+                        out, _ = block.apply({"params": params_v}, carry,
+                                             None)
+                        return out
+
+                    return jax.lax.cond(v < n_real, run, lambda: carry), None
+
+                h, _ = jax.lax.scan(
+                    body, h, (jnp.arange(local_layers), stage_params))
+                return h
+
+            stage_fn.takes_slot = True
 
         def loss_fn(shared, h, mb):
             h = ln_f.apply({"params": shared["ln_f"]}, h)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
-from .common import ModelOutput, cross_entropy_loss, shift_labels
+from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift_labels
 
 
 @dataclasses.dataclass(frozen=True)
@@ -221,7 +221,7 @@ class GPTNeoForCausalLM(nn.Module):
         block_cls = NeoBlock
         if cfg.remat:
             block_cls = nn.remat(
-                NeoBlock, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                NeoBlock, policy=resolve_remat_policy(cfg.remat_policy),
                 prevent_cse=False)
         if cfg.scan_layers:
             stack = nn.scan(block_cls,

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
 from ..ops.rotary import apply_rotary_pos_emb
-from .common import ModelOutput, cross_entropy_loss, shift_labels
+from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift_labels
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,7 +199,7 @@ class LlamaForCausalLM(nn.Module):
         block_cls = LlamaBlock
         if cfg.remat:
             block_cls = nn.remat(
-                LlamaBlock, policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                LlamaBlock, policy=resolve_remat_policy(cfg.remat_policy),
                 prevent_cse=False)
         if cfg.scan_layers:
             stack = nn.scan(block_cls,

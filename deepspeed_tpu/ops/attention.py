@@ -15,6 +15,7 @@ Shapes follow the JAX convention ``(batch, seq, heads, head_dim)``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -64,7 +65,13 @@ def dot_product_attention(
     if impl == "skip":
         # measurement probe ONLY: attention replaced by identity-on-q so
         # an e2e A/B isolates the attention kernel's true step-time share
-        # (isolated kernel probes mislead — see BENCH_NORTHSTAR.md)
+        # (isolated kernel probes mislead — see BENCH_NORTHSTAR.md).
+        # Gated: outside the probe harness this silently produces garbage.
+        if not os.environ.get("DS_TPU_ALLOW_SKIP_ATTN"):
+            raise ValueError(
+                "attn impl='skip' disables attention entirely (identity on "
+                "q) and exists only for step-time A/B probes; set "
+                "DS_TPU_ALLOW_SKIP_ATTN=1 if that is really what you want")
         return q
     impl = _pick_impl(impl, q)
     if impl == "flash" and bias is None and mask is None and dropout_rate == 0.0:

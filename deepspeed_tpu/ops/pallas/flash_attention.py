@@ -16,16 +16,19 @@ All kernels run under ``interpret=True`` on CPU for tests.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 NEG_INF = float("-inf")
 
 HEADS_PER_PROGRAM = 1   # module knob; see flash_attention()
 UNROLL_MAX = 4          # static-unroll K/Q sweeps at or below this length
+BWD_MODE = "merged"     # "merged" | "split"; env DS_TPU_FLASH_BWD overrides
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
@@ -176,6 +179,74 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[g] = dv.astype(dv_ref.dtype)
 
 
+def _dqkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                 block_k, G):
+    """Merged backward: dq, dk AND dv in ONE grid pass over k-blocks.
+
+    The split dq/dkv pair recomputes the score and dp matmuls in both
+    kernels (7 MXU ops per block-pair) and streams K/V twice; computing
+    ds once and feeding all three cotangents cuts that to 5 and halves
+    the re-streaming.  dq is accumulated in a VMEM-resident fp32 output
+    block whose index map ignores the k-block grid dim — TPU grids are
+    sequential, so the block is revisited across k-blocks and flushed
+    once per (batch·head) program.  dk carries ``scale`` via the
+    pre-scaled q (same convention as the split kernels); dq is scaled by
+    the caller after the final cast."""
+    ki = pl.program_id(1)
+    S = q_ref.shape[1]
+    nq = S // block_q
+
+    @pl.when(ki == 0)
+    def _init_dq():
+        dq_ref[...] = jnp.zeros(dq_ref.shape, dq_ref.dtype)
+
+    lo = (ki * block_k) // block_q if causal else 0
+
+    for g in range(G):
+        k = k_ref[g].astype(jnp.float32)                         # (bk, D)
+        v = v_ref[g].astype(jnp.float32)
+        dk_ref[g] = jnp.zeros(dk_ref.shape[1:], dk_ref.dtype)
+        dv_ref[g] = jnp.zeros(dv_ref.shape[1:], dv_ref.dtype)
+
+        def body(i, _, g=g, k=k, v=v):
+            q = q_ref[g, pl.ds(i * block_q, block_q)] \
+                .astype(jnp.float32) * scale
+            do = do_ref[g, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+            lse = lse_ref[g, 0, pl.ds(i * block_q, block_q)]
+            delta = delta_ref[g, 0, pl.ds(i * block_q, block_q)]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                q_pos = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])                        # (bq, bk)
+            dv_ref[g] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None])
+            dk_ref[g] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+            dq_ref[g, pl.ds(i * block_q, block_q)] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+            return 0
+
+        if nq <= UNROLL_MAX:
+            for i in range(nq):
+                @pl.when(jnp.asarray(i, jnp.int32) >= lo)
+                def _step(i=i):
+                    body(i, None)
+        else:
+            jax.lax.fori_loop(lo, nq, body, 0)
+
+
 def _largest_dividing_block(s: int, cap: int) -> int:
     """Largest tile ≤ cap that divides s (so S=1536 gets 512, S=1152 gets
     128 — any S that a smaller default handled keeps working)."""
@@ -220,6 +291,11 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, G, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    # named so a "<policy>+flash" remat policy can SAVE the kernel's
+    # residuals: out/lse aren't dot outputs, so dots_saveable alone
+    # recomputes the whole fwd kernel inside every backward pass
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
@@ -235,6 +311,36 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, G, interpret,
                     q, k, v, lse, do, delta):
     BH, S, D = q.shape
     Sk = k.shape[1]
+
+    if os.environ.get("DS_TPU_FLASH_BWD", BWD_MODE) == "merged":
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, G=G),
+            grid=(BH // G, Sk // block_k),
+            in_specs=[
+                pl.BlockSpec((G, S, D), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((G, block_k, D), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((G, block_k, D), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((G, S, D), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((G, 1, S), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((G, 1, S), lambda b, j: (b, 0, 0)),
+            ],
+            out_specs=[
+                # dq revisited across j (map ignores the k-block dim):
+                # fp32 VMEM accumulator, flushed once per (batch·head)
+                pl.BlockSpec((G, S, D), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((G, block_k, D), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((G, block_k, D), lambda b, j: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+                jax.ShapeDtypeStruct((BH, Sk, D), jnp.float32),
+                jax.ShapeDtypeStruct((BH, Sk, D), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        return ((dq * scale).astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,

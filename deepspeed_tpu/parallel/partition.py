@@ -1,0 +1,229 @@
+"""Pipeline stage partitioning: balanced layer→stage placement.
+
+Reference mechanism (``runtime/pipe/module.py:363`` ``_partition_layers``
+with ``method='uniform' | 'parameters' | 'type:regex'`` backed by
+``runtime/utils.py`` ``partition_balanced``): stages own contiguous layer
+ranges sized to balance per-stage load.
+
+TPU-native design: every stage must run the SAME compiled sub-stack
+(the pipeline is one SPMD ``lax.scan`` over a ``pp``-sharded stacked
+layer dim — ``parallel/pipeline.py``), so per-stage layer counts cannot
+differ *structurally*.  Instead the stack is padded to
+``local·n_stages`` slots and balancing chooses WHICH slots are real
+layers and which are zero-weight identity blocks: a stage that should
+carry less transformer work (e.g. the embed stage or the E×V head
+stage under ``method='parameters'``) gets its slack as pad slots.  The
+placement is a static gather index — applied once at storage time, it
+costs nothing per step.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+
+def partition_balanced(weights: Sequence[float], parts: int) -> list:
+    """Contiguous partition of ``weights`` into ``parts`` ranges
+    minimizing the maximum range sum.  Returns ``parts + 1`` boundaries
+    (``b[i]:b[i+1]`` is part i's slice).  The reference's
+    ``ds_utils.partition_balanced`` contract; implemented as binary
+    search over the max-load with a greedy feasibility check."""
+    n = len(weights)
+    if parts < 1:
+        raise ValueError(f"parts must be >= 1, got {parts}")
+    w = [float(x) for x in weights]
+
+    def feasible(cap: float) -> Optional[list]:
+        bounds, load, used = [0], 0.0, 1
+        for i, x in enumerate(w):
+            if x > cap:
+                return None
+            if load + x > cap:
+                bounds.append(i)
+                load, used = x, used + 1
+                if used > parts:
+                    return None
+            else:
+                load += x
+        while len(bounds) < parts:      # trailing empty parts
+            bounds.append(n)
+        bounds.append(n)
+        return bounds
+
+    lo, hi = max(w, default=0.0), sum(w)
+    best = feasible(hi) or [0] + [n] * parts
+    for _ in range(64):                 # bisect to float precision
+        mid = (lo + hi) / 2
+        b = feasible(mid)
+        if b is None:
+            lo = mid
+        else:
+            best, hi = b, mid
+    return best
+
+
+@dataclasses.dataclass(frozen=True)
+class StageLayout:
+    """Static layer→slot placement for a padded, stage-sharded stack.
+
+    ``slots[j]`` is the real-layer index occupying padded slot ``j``
+    (stage ``j // local_layers``, slot ``j % local_layers``), or ``-1``
+    for a zero-pad identity block.  Real layers appear in increasing
+    order (pipeline order is preserved); pads sit after a stage's real
+    layers (an identity block after real blocks is exact)."""
+    n_layer: int
+    n_stages: int
+    local_layers: int
+    slots: tuple
+
+    @property
+    def padded_layers(self) -> int:
+        return self.local_layers * self.n_stages
+
+    @property
+    def trivial(self) -> bool:
+        """True when stored layout == canonical layout (divisible count,
+        uniform placement) — every transform is the identity."""
+        return self.padded_layers == self.n_layer and \
+            self.slots == tuple(range(self.n_layer))
+
+    @property
+    def gather_idx(self) -> tuple:
+        """Canonical→stored gather over ``concat([stack, zero_row])``:
+        pad slots point at the appended zero row (index ``n_layer``)."""
+        return tuple(s if s >= 0 else self.n_layer for s in self.slots)
+
+    @property
+    def inv_idx(self) -> tuple:
+        """Stored→canonical gather: slot index of each real layer."""
+        out = [0] * self.n_layer
+        for j, s in enumerate(self.slots):
+            if s >= 0:
+                out[s] = j
+        return tuple(out)
+
+    def stage_counts(self) -> list:
+        """Real layers per stage (diagnostics / tests)."""
+        L = self.local_layers
+        return [sum(1 for s in self.slots[i * L:(i + 1) * L] if s >= 0)
+                for i in range(self.n_stages)]
+
+    # single source of truth for the canonical↔placed leaf transforms —
+    # gpt2.pipeline_fns (split/merge) and Engine._stage_leaf_transform
+    # (opt-state walker) both route through these
+    def place(self, leaf):
+        """Canonical (n_layer, …) → placed padded (padded_layers, …);
+        pad slots are zero rows."""
+        import jax.numpy as jnp
+
+        zero = jnp.zeros((1,) + leaf.shape[1:], leaf.dtype)
+        return jnp.concatenate([leaf, zero])[jnp.asarray(self.gather_idx)]
+
+    def unplace(self, leaf):
+        """Placed padded → canonical: gathers each real layer's slot."""
+        import jax.numpy as jnp
+
+        return leaf[jnp.asarray(self.inv_idx)]
+
+
+def make_layout(n_layer: int, n_stages: int, method: str = "uniform", *,
+                layer_weights: Optional[Sequence[float]] = None,
+                layer_types: Optional[Sequence[str]] = None,
+                stage_extras: Optional[Sequence[float]] = None
+                ) -> StageLayout:
+    """Build a :class:`StageLayout` for ``method``:
+
+    - ``"uniform"`` — ceil split, pads on the last stage (the round-3
+      behavior; stored layout equals canonical for divisible counts).
+    - ``"parameters"`` — balance per-layer ``layer_weights`` (param
+      counts) plus fixed per-stage ``stage_extras`` (embed/head loads).
+    - ``"type:<regex>"`` — layers whose ``layer_types`` name matches the
+      regex weigh 1, others 0 (reference ``type:regex`` semantics), then
+      balance.
+    """
+    if n_stages < 1 or n_layer < 1:
+        raise ValueError(f"need n_layer/n_stages >= 1, got "
+                         f"{n_layer}/{n_stages}")
+    local = -(-n_layer // n_stages)
+    if method == "uniform":
+        slots = list(range(n_layer)) + [-1] * (local * n_stages - n_layer)
+        return StageLayout(n_layer, n_stages, local, tuple(slots))
+
+    if method == "parameters":
+        weights = list(layer_weights) if layer_weights is not None \
+            else [1.0] * n_layer
+    elif method.startswith("type:"):
+        pat = re.compile(method[len("type:"):], re.IGNORECASE)
+        types = list(layer_types) if layer_types is not None \
+            else ["layer"] * n_layer
+        if len(types) != n_layer:
+            raise ValueError("layer_types length != n_layer")
+        weights = [1.0 if pat.search(t) else 0.0 for t in types]
+    else:
+        raise ValueError(
+            f"unknown partition method {method!r} "
+            "(uniform | parameters | type:<regex>)")
+    if len(weights) != n_layer:
+        raise ValueError("layer_weights length != n_layer")
+    if sum(weights) <= 0:
+        # no balancing signal (e.g. a type:<regex> matching no layer):
+        # a zero-cap greedy pack would pile EVERY layer on stage 0 and
+        # inflate the padded stack n_stages× — fall back to uniform
+        return make_layout(n_layer, n_stages, "uniform")
+
+    extras = list(stage_extras or [0.0] * n_stages)
+    if len(extras) != n_stages:
+        raise ValueError("stage_extras length != n_stages")
+
+    # Balance layers + per-stage fixed extras: since stages are ordered
+    # and layers contiguous, fold each stage's extra into the search by
+    # trying all boundary sets via capacity bisection over (layer run +
+    # extra).  Greedy-with-extras: feasible(cap) packs layers left to
+    # right, opening stage s with budget cap - extras[s].
+    def feasible(cap):
+        bounds, used, load = [0], 0, extras[0]
+        if load > cap:
+            return None
+        for i, x in enumerate(weights):
+            if load + x > cap:
+                used += 1
+                if used >= n_stages:
+                    return None
+                bounds.append(i)
+                load = extras[used] + x
+                if load > cap:
+                    return None
+            else:
+                load += x
+        while len(bounds) < n_stages:
+            bounds.append(n_layer)
+        bounds.append(n_layer)
+        return bounds
+
+    lo = max(max(weights, default=0.0), max(extras))
+    hi = sum(weights) + max(extras)
+    best = feasible(hi)
+    if best is None:
+        best = [0] + [n_layer] * n_stages
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        b = feasible(mid)
+        if b is None:
+            lo = mid
+        else:
+            best, hi = b, mid
+
+    counts = [best[i + 1] - best[i] for i in range(n_stages)]
+    # slot count per stage = the widest stage: SPMD needs every stage to
+    # run the same program, so a balance whose widest stage exceeds the
+    # uniform ceil WIDENS the whole padded stack (more slots, more pad
+    # memory) — the trade the caller opted into by asking for balancing;
+    # extra slots are skipped at run time by the cond-gated stage fn
+    local = max(max(counts), local)
+    slots, nxt = [], 0
+    for s in range(n_stages):
+        row = list(range(nxt, nxt + counts[s]))
+        nxt += counts[s]
+        slots.extend(row + [-1] * (local - counts[s]))
+    return StageLayout(n_layer, n_stages, local, tuple(slots))

@@ -378,11 +378,21 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
         lambda p: jnp.zeros(p.shape, f32), stage_params), axis)
     loss0 = _pvary(jnp.zeros((), f32), axis)
 
+    # placed layouts gate slots on the chunk's real-layer count — the
+    # stage fn needs its static local-slot index to resolve the chunk id
+    takes_slot = getattr(stage_fn, "takes_slot", False)
+
+    def chunk_fn(v):
+        if takes_slot:
+            return lambda p, h: stage_fn(p, h, v)
+        return stage_fn
+
     def tick(carry, t):
         fwd_buf, ct_buf, resid, g_sh, g_st, loss_acc = carry
         ys, cts = [], []
         for v in range(V):            # static unroll over local chunks
             params_v = chunk_params(v)
+            sfn_v = chunk_fn(v)
             g = v * S + sid
             # ---- forward ----
             f = t - g
@@ -393,7 +403,7 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
                 x = lax.cond(sid == 0,
                              lambda: embed_fn(shared_params, mb_f),
                              lambda: fwd_buf[0])
-            ys.append(stage_fn(params_v, x))
+            ys.append(sfn_v(params_v, x))
             slot_f = jnp.mod(jnp.maximum(f, 0), D)
             resid = jnp.where(
                 do_fwd,
@@ -406,7 +416,7 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
             mb_k = pick_mb(k)
             x_k = lax.dynamic_index_in_dim(
                 resid[v], jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
-            y_k, stage_vjp = jax.vjp(stage_fn, params_v, x_k)
+            y_k, stage_vjp = jax.vjp(sfn_v, params_v, x_k)
             if v == V - 1:            # final chunk: loss head seeds ct
                 is_final = sid == S - 1
 

@@ -387,11 +387,11 @@ class GatheredParameters:
             import dataclasses as _dc
 
             stored = resharded
-            if getattr(self._engine, "_interleave", None) is not None:
+            if getattr(self._engine, "_has_store_transform", False):
                 # the context works in canonical (global) layer order —
-                # engine storage is local-slot order (interleaved-1F1B)
-                stored = self._engine._permute_params(
-                    stored, self._engine._interleave[0])
+                # engine storage may be local-slot permuted (interleaved)
+                # and/or padded+placed (balanced/uneven partitioning)
+                stored = self._engine._to_stored_params(stored)
             self._engine._state = _dc.replace(self._engine._state,
                                               params=stored)
         return False

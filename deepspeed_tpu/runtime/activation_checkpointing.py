@@ -54,12 +54,10 @@ def configure(deepspeed_config=None, partition_activations=None,
 
 
 def _policy():
+    from ..models.common import resolve_remat_policy
+
     name = _config.policy if _config.enabled else "nothing_saveable"
-    pol = getattr(jax.checkpoint_policies, name, None)
-    if pol is None:
-        raise ValueError(f"unknown remat policy {name!r}; see "
-                         "jax.checkpoint_policies")
-    return pol
+    return resolve_remat_policy(name)
 
 
 def checkpoint(function: Callable, *args) -> Any:

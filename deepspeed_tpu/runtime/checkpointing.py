@@ -224,8 +224,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         return None, {}
 
     engine._require_state()
+    # prefer each leaf's live sharding: under a storage transform
+    # (padded/permuted stack) the canonical view the engine presents here
+    # has different shapes than engine._state_shardings describes
     abstract = jax.tree_util.tree_map(
-        lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+        lambda x, sh: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None) or sh),
         engine.state, engine._state_shardings)
     ckptr = _checkpointer()
     engine._state = ckptr.restore(state_path, abstract)

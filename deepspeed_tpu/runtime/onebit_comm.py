@@ -117,7 +117,11 @@ def step_factory(mesh, loss_fn, lr_fn, *, b1: float, b2: float, eps: float,
                 ne.append(n_)
             mu_avg = jax.tree_util.tree_unflatten(treedef, tot)
             err_n = jax.tree_util.tree_unflatten(treedef, ne)
-            return mu_avg, mu_w, nu, err_n
+            # store the SYNCHRONIZED momentum (reference onebit/adam.py:216
+            # exp_avg.set_(compressed_allreduce(...))): per-worker error
+            # feedback already lives in err_n, and keeping worker-local
+            # momenta would drift them apart across steps
+            return mu_avg, mu_avg, nu, err_n
 
         if freeze_step == 0:
             mu_use, mu_store, nu_new, err_new = comp_branch()

@@ -179,8 +179,14 @@ class ParamOffloadRunner:
         self._jit_embed = jax.jit(self._embed_fn)
         self._jit_fwd = jax.jit(fwd)
         self._jit_bwd = jax.jit(bwd, static_argnums=(3,))
-        self._jit_head = jax.jit(head)
-        self._jit_embed_bwd = jax.jit(embed_bwd)
+        # shared-param grads are fetched with np.asarray on every process
+        # (step(): sh_flat concat) — that contract requires them fully
+        # replicated, so pin it; GSPMD left free may emit sharded outputs
+        # on a multi-host mesh.  ct stays unconstrained (batch-sharded).
+        self._jit_head = jax.jit(
+            head, out_shardings=(self._repl_sh, self._repl_sh, None))
+        self._jit_embed_bwd = jax.jit(embed_bwd,
+                                      out_shardings=self._repl_sh)
 
     # ------------------------------------------------------------------
     def _alloc(self, name: str, size: int) -> np.ndarray:

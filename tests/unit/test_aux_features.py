@@ -88,6 +88,69 @@ def test_pld_trains():
     assert np.isfinite(losses).all()
 
 
+def _fresh_gpt2_engine(extra_cfg):
+    mesh_mod.set_mesh(None)
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny"))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**6, **extra_cfg})
+    engine.init_params()
+    return engine
+
+
+def test_curriculum_multi_step_matches_per_step():
+    """train_batches with curriculum == N train_batch calls: the window
+    splits into equal-seqlen segments (one XLA program per pow2 bucket)."""
+    cl = {"curriculum_learning": {
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4,
+                            "difficulty_step": 8}}}
+    e1 = _fresh_gpt2_engine(cl)
+    batch = token_batch(e1.train_batch_size, 64, 512)
+    l_ref = [float(e1.train_batch(batch)) for _ in range(5)]
+    e2 = _fresh_gpt2_engine(cl)
+    l_multi = np.asarray(jax.device_get(e2.train_batches(batch, steps=5)))
+    np.testing.assert_allclose(l_multi, l_ref, rtol=2e-4, atol=1e-6)
+    assert e2.curriculum_scheduler.current_difficulty == \
+        e1.curriculum_scheduler.current_difficulty
+    assert e2.global_steps == 5
+
+
+def test_pld_multi_step_matches_per_step():
+    """PLD theta is a pure function of global_step — precomputed host-side
+    and scanned, the multi-step path matches per-step exactly."""
+    pld = {"progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                      "gamma": 0.01}}
+    e1 = _fresh_gpt2_engine(pld)
+    batch = token_batch(e1.train_batch_size, 32, 512)
+    l_ref = [float(e1.train_batch(batch)) for _ in range(4)]
+    e2 = _fresh_gpt2_engine(pld)
+    l_multi = np.asarray(jax.device_get(e2.train_batches(batch, steps=4)))
+    np.testing.assert_allclose(l_multi, l_ref, rtol=2e-4, atol=1e-6)
+    assert e2.progressive_layer_drop.current_theta == \
+        pytest.approx(e1.progressive_layer_drop.current_theta)
+
+
+def test_fp16_multi_step_matches_per_step():
+    """fp16's loss-scale machine lives in carried device state; the host
+    skipped_steps counter is reconciled from the scanned overflow flags."""
+    fp16 = {"fp16": {"enabled": True, "initial_scale_power": 4,
+                     "loss_scale_window": 2}}
+    e1 = _fresh_gpt2_engine(fp16)
+    batch = token_batch(e1.train_batch_size, 32, 512)
+    l_ref = [float(e1.train_batch(batch)) for _ in range(6)]
+    skipped_ref = e1.skipped_steps
+    e2 = _fresh_gpt2_engine(fp16)
+    l_multi = np.asarray(jax.device_get(e2.train_batches(batch, steps=6)))
+    np.testing.assert_allclose(l_multi, l_ref, rtol=2e-4, atol=1e-6)
+    assert e2.skipped_steps == skipped_ref
+    assert float(jax.device_get(e2.state.loss_scale.scale)) == \
+        float(jax.device_get(e1.state.loss_scale.scale))
+
+
 def test_moq_quantizes_weights():
     engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(), config={
         "train_micro_batch_size_per_gpu": 2,

@@ -1,0 +1,168 @@
+"""Stage-placement tests (reference ``pipe/module.py:363``
+``_partition_layers`` with method uniform/parameters/type:regex, backed by
+``ds_utils.partition_balanced``)."""
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+from deepspeed_tpu.parallel.partition import (StageLayout, make_layout,
+                                              partition_balanced)
+
+from .simple_model import token_batch
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _max_load(weights, extras, bounds):
+    loads = []
+    for s in range(len(bounds) - 1):
+        loads.append(sum(weights[bounds[s]:bounds[s + 1]]) + extras[s])
+    return max(loads)
+
+
+def test_partition_balanced_minimizes_max():
+    w = [5, 1, 1, 1, 1, 5]
+    b = partition_balanced(w, 3)
+    assert b[0] == 0 and b[-1] == len(w) and len(b) == 4
+    assert sorted(b) == b
+    assert _max_load(w, [0, 0, 0], b) <= 6   # optimal: [5,1][1,1,1][5]=6
+
+    # degenerate: one part takes everything
+    assert partition_balanced([3, 3], 1) == [0, 2]
+    # more parts than items: trailing empties
+    b = partition_balanced([1], 3)
+    assert b[0] == 0 and b[-1] == 1
+
+
+def test_make_layout_uniform_matches_round3_padding():
+    lay = make_layout(3, 2, "uniform")
+    assert lay.local_layers == 2 and lay.padded_layers == 4
+    assert lay.slots == (0, 1, 2, -1)       # pads at the end
+    assert not lay.trivial
+    assert lay.stage_counts() == [2, 1]
+    lay4 = make_layout(4, 2, "uniform")
+    assert lay4.trivial
+
+
+def test_make_layout_parameters_balances_fat_ends():
+    # equal layers, heavy extras on first/last stage: the middle stages
+    # should absorb more real layers than uniform would give them
+    n_layer, stages = 8, 4
+    w = [1.0] * n_layer
+    extras = [3.0, 0.0, 0.0, 3.0]
+    lay = make_layout(n_layer, stages, "parameters",
+                      layer_weights=w, stage_extras=extras)
+    counts = lay.stage_counts()
+    assert sum(counts) == n_layer
+    uniform_load = _max_load(w, extras, [0, 2, 4, 6, 8])      # 2 each → 5
+    bal_bounds = [0]
+    for c in counts:
+        bal_bounds.append(bal_bounds[-1] + c)
+    assert _max_load(w, extras, bal_bounds) < uniform_load
+    # real layers stay in pipeline order
+    real = [s for s in lay.slots if s >= 0]
+    assert real == sorted(real)
+    # round-trip: gather then inverse-gather is the identity
+    g = np.asarray(lay.gather_idx)
+    inv = np.asarray(lay.inv_idx)
+    stack = np.arange(n_layer)
+    padded = np.concatenate([stack, [-7]])[g]
+    np.testing.assert_array_equal(padded[inv], stack)
+
+
+def test_make_layout_type_regex():
+    lay = make_layout(4, 2, "type:block",
+                      layer_types=["Block", "Block", "Block", "Block"])
+    assert sum(lay.stage_counts()) == 4
+    with pytest.raises(ValueError):
+        make_layout(4, 2, "bogus")
+
+
+def test_gpt2_parameters_method_beats_uniform_balance():
+    """VERDICT #5 test: a fat-embed/head model gets a measurably better
+    parameter balance than uniform."""
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", n_layer=8,
+                                        vocab_size=8192))
+    uni = model.pipeline_layout(4, "uniform")
+    bal = model.pipeline_layout(4, "parameters")
+    cfg = model.cfg
+    block_w = 12 * cfg.n_embd ** 2 + 13 * cfg.n_embd
+    extras = [0.0] * 4
+    extras[0] = (cfg.padded_vocab_size + cfg.n_positions) * cfg.n_embd
+    extras[-1] = cfg.padded_vocab_size * cfg.n_embd
+
+    def max_load(lay):
+        return max(c * block_w + e
+                   for c, e in zip(lay.stage_counts(), extras))
+
+    assert max_load(bal) < max_load(uni)
+
+
+def test_uneven_stack_stays_pp_sharded():
+    """VERDICT #5: uneven layer counts must NOT replicate the stacked
+    layer dim — storage is padded to ceil and sharded over pp."""
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", n_layer=3,
+                                        scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "mesh": {"pp": 2, "dp": 4}})
+    engine.init_params()
+    kernel = engine.state.params["h"]["attn"]["c_attn_kernel"]
+    assert kernel.shape[0] == 4, "storage must be padded to ceil"
+    assert "pp" in str(kernel.sharding.spec), \
+        f"padded stack must shard over pp, got {kernel.sharding.spec}"
+    # canonical view slices back to the real layer count
+    assert engine.params["h"]["attn"]["c_attn_kernel"].shape[0] == 3
+    batch = token_batch(engine.train_batch_size, 32, 512)
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_interleaved_uneven_layers_train():
+    """Interleaved + uneven now composes (padded counts divide pp·V)."""
+    model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", n_layer=6,
+                                        scan_layers=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "pipeline": {"schedule": "interleaved", "virtual_stages": 2},
+        "mesh": {"pp": 2, "dp": 4}})
+    engine.init_params()
+    batch = token_batch(engine.train_batch_size, 32, 512)
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # canonical view keeps the true layer count
+    assert engine.params["h"]["attn"]["c_attn_kernel"].shape[0] == 6
+
+
+def test_balanced_placement_matches_uniform_losses():
+    """Placement changes WHERE layers live, not the math: balanced and
+    uniform engines started from the same seed train identically."""
+    def run(method):
+        mesh_mod.set_mesh(None)
+        model = GPT2LMHeadModel(gpt2_config("gpt2-tiny", n_layer=6,
+                                            scan_layers=True))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "pipeline": {"schedule": "1f1b", "partition_method": method},
+            "mesh": {"pp": 4, "dp": 2}})
+        engine.init_params()
+        batch = token_batch(engine.train_batch_size, 32, 512, seed=5)
+        return [float(engine.train_batch(batch)) for _ in range(3)]
+
+    l_uni = run("uniform")
+    l_bal = run("parameters")
+    np.testing.assert_allclose(l_bal, l_uni, rtol=2e-4, atol=1e-6)
