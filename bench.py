@@ -148,6 +148,7 @@ def bench_serving():
         batcher = ContinuousBatcher(eng, n_slots=slots)
         ticks = 16 if on_tpu else 4
         batcher.run(prompts[:slots], max_new_tokens=4, ticks=ticks)  # warm
+        batcher.warmup_windows(ticks)   # pow2 sub-window executables
         batcher.reset_latency_stats()   # keep compile-time TTFTs out
         t0 = time.perf_counter()
         outs = batcher.run(prompts, max_new_tokens=new_toks, ticks=ticks)
@@ -176,9 +177,14 @@ def bench_serving():
 def bench_moe_serving():
     """MoE serving row (reference claims 1.24-1.6× serving gains,
     mixture-of-experts-inference.md:81): decode tok/s of a top-1 MoE
-    model whose ACTIVE parameters match a dense base — the speed of
-    serving base-model FLOPs while holding num_experts× FFN capacity
-    (the reference's same-quality-cheaper-serving framing)."""
+    model whose ACTIVE parameters match a dense base, against BOTH
+    baselines the comparison needs to be honest (round-3 verdict):
+    the compute-matched dense base (125M — same active FLOPs, measures
+    pure dispatch overhead) and a QUALITY-matched bigger dense model
+    (350M — parameter count in the MoE's class; the reference's framing
+    is that the MoE serves that quality cheaper).  EP-sharded decode
+    correctness is covered on the 8-device mesh by
+    ``test_moe_inference_ep_sharded``."""
     import jax
     import numpy as np
 
@@ -193,8 +199,8 @@ def bench_moe_serving():
         ("gpt2-tiny", 2, 8, 8, 2)
     rng = np.random.default_rng(0)
 
-    def run(moe):
-        cfg = gpt2_config(preset, moe=moe, scan_layers=True)
+    def run(moe, model_preset=None):
+        cfg = gpt2_config(model_preset or preset, moe=moe, scan_layers=True)
         model = GPT2LMHeadModel(cfg)
         params = jax.tree_util.tree_map(
             lambda x: getattr(x, "value", x),
@@ -219,22 +225,35 @@ def bench_moe_serving():
 
     moe_tok_s, moe_params = run(MoEConfig(num_experts=experts, top_k=1))
     dense_tok_s, dense_params = run(None)
-    return {"model": preset, "experts": experts,
-            "moe_decode_tok_s": moe_tok_s,
-            "dense_decode_tok_s": dense_tok_s,
-            "moe_total_params_m": round(moe_params / 1e6, 1),
-            "dense_total_params_m": round(dense_params / 1e6, 1),
-            "decode_ratio": round(moe_tok_s / dense_tok_s, 2)
-            if dense_tok_s else None}
+    out = {"model": preset, "experts": experts,
+           "moe_decode_tok_s": moe_tok_s,
+           "dense_decode_tok_s": dense_tok_s,
+           "moe_total_params_m": round(moe_params / 1e6, 1),
+           "dense_total_params_m": round(dense_params / 1e6, 1),
+           "vs_compute_matched_dense": round(moe_tok_s / dense_tok_s, 2)
+           if dense_tok_s else None}
+    if on_tpu:
+        # quality-matched baseline: a dense model in the MoE's total-
+        # parameter class (the reference's "same quality, cheaper
+        # serving" claim needs the MoE to beat THIS number)
+        big_tok_s, big_params = run(None, model_preset="gpt2-350m")
+        out["dense_350m_decode_tok_s"] = big_tok_s
+        out["dense_350m_total_params_m"] = round(big_params / 1e6, 1)
+        out["vs_quality_matched_dense"] = \
+            round(moe_tok_s / big_tok_s, 2) if big_tok_s else None
+    return out
 
 
-def bench_northstar(steps: int = 8):
+def bench_northstar(steps: int = 32):
     """GPT-2-1.5B ZeRO-3 on one chip (the BASELINE.json metric).
 
     Memory recipe (16 GB chip): int8 Adam moments (adamw8bit), unrolled
     layers (per-layer grads free as their update runs), micro=2, remat
-    dots_with_no_batch_dims_saveable, flash attention.  Returns the
-    result dict (also printed standalone by --mode northstar)."""
+    dots_saveable+flash, flash attention with the merged backward.
+    ``steps=32``: one compiled 32-step scan per window (round-4 sweep:
+    8→16→32 steps = 0.978→1.004→1.023 vs_ref — dispatch amortization
+    the reference's continuous train loop enjoys too).  Returns the result dict (also printed
+    standalone by --mode northstar)."""
     import jax
     import numpy as np
 
@@ -250,10 +269,14 @@ def bench_northstar(steps: int = 8):
 
     mesh_mod.set_mesh(None)
     # sweep (BENCH_NORTHSTAR.md): micro 2 > 3 > 1; micro 4 OOMs (dense
-    # head) and trails with the chunked head; dots_saveable ~= no-batch-
-    # dims policy; scanned stack OOMs (monolithic (48,...) fp32 grads)
+    # head) and trails with the chunked head; scanned stack OOMs
+    # (monolithic (48,...) fp32 grads).  Round 4: "+flash" saves the
+    # flash kernel's residuals so backward skips its fwd recompute
+    # (+0.9% on top of the merged dq/dk/dv kernel's +3.4%).
     cfg = gpt2_config(preset, n_positions=seq, scan_layers=not on_tpu,
-                      remat=True, remat_policy="dots_saveable",
+                      remat=True,
+                      remat_policy="dots_saveable+flash" if on_tpu
+                      else "dots_saveable",
                       attn_impl="auto",
                       loss_chunk=8192 if on_tpu else None)
     base_cfg = {

@@ -149,6 +149,11 @@ class Autotuner:
         kernels: list[dict] = [
             {"scan_layers": False, "loss_chunk": None},
             {"scan_layers": False, "loss_chunk": 8192},
+            # round-4 winner: save the flash kernel's residuals so the
+            # backward skips its forward recompute (models/common.py
+            # resolve_remat_policy "+flash" suffix)
+            {"scan_layers": False, "loss_chunk": 8192,
+             "remat_policy": "dots_saveable+flash"},
             {"scan_layers": False, "loss_chunk": 8192,
              "remat_policy": "dots_with_no_batch_dims_saveable"},
             # scanned stack: expected to OOM at 1.5B (monolithic stacked

@@ -59,7 +59,8 @@ class ContinuousBatcher:
     def __init__(self, engine: InferenceEngine, n_slots: int = 4, *,
                  top_k: int = 0, eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None, seed: int = 0,
-                 chunked_prefill: bool = True):
+                 chunked_prefill: bool = True,
+                 prefill_ahead: Optional[int] = None):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
@@ -96,6 +97,15 @@ class ContinuousBatcher:
         self._done = jnp.ones((n_slots, 1), bool)      # free ⇒ done
         self._slots: List[Optional[_Active]] = [None] * n_slots
         self._queue: deque = deque()
+        # prefill-ahead (the TTFT lever): queued requests are prefilled
+        # and their FIRST token sampled while every slot is still busy;
+        # the 1-row cache parks here until a slot frees.  TTFT becomes
+        # queueing-for-prefill + prefill, decoupled from how long the
+        # current wave keeps decoding.  ``prefill_ahead`` bounds parked
+        # caches (HBM: one gen-limit KV cache each); 0 disables.
+        self._parked: deque = deque()
+        self.prefill_ahead = n_slots if prefill_ahead is None \
+            else int(prefill_ahead)
         self._tick_no = 0
         self._next_uid = 0
         self._finished: Dict[int, np.ndarray] = {}
@@ -160,19 +170,27 @@ class ContinuousBatcher:
 
         self._multi_step = multi_step
 
-        # admission: ONE jitted scatter of the new slot's cache + sampling
-        # state, with the slot index TRACED (a python-int index would bake
-        # into the program and recompile per slot — pathological on a
-        # tunneled device where each compile pays seconds of RTT)
-        def admit_fn(cache, token, pos, temp, top_p, rep, seen, done,
-                     cache1, last_logits, prompt_seen, prompt_len, uid, i,
-                     r_temp, r_top_p, r_rep):
+        # admission is two jitted phases so the first token can be
+        # produced BEFORE a slot frees (prefill-ahead — the TTFT lever):
+        # (1) sample the first token from the prefill logits; (2) scatter
+        # the parked cache + sampling state into slot ``i``.  Both keep
+        # every index TRACED (a python-int index would bake into the
+        # program and recompile per slot/uid — pathological on a tunneled
+        # device where each compile pays seconds of RTT).
+        def first_token_fn(last_logits, prompt_seen, uid, r_temp, r_top_p,
+                           r_rep):
             key = jax.random.fold_in(jax.random.PRNGKey(base_seed), uid)
-            seen1 = prompt_seen
             first = _sample(last_logits.astype(jnp.float32), key,
-                            r_temp, top_k_static, r_top_p, r_rep, seen1)
-            seen1 = seen1.at[jnp.arange(1), first].set(True)
+                            r_temp, top_k_static, r_top_p, r_rep,
+                            prompt_seen)
+            seen1 = prompt_seen.at[jnp.arange(1), first].set(True)
+            return first, seen1
 
+        self._first_token_fn = jax.jit(first_token_fn)
+
+        def place_fn(cache, token, pos, temp, top_p, rep, seen, done,
+                     cache1, first, seen1, prompt_len, i,
+                     r_temp, r_top_p, r_rep):
             def put(big, small):
                 return jax.lax.dynamic_update_slice(
                     big, small[None].astype(big.dtype),
@@ -186,9 +204,9 @@ class ContinuousBatcher:
             rep = put(rep, r_rep)
             seen = put(seen, seen1)
             done = put(done, first == jnp.int32(self.eos))
-            return cache, token, pos, temp, top_p, rep, seen, done, first
+            return cache, token, pos, temp, top_p, rep, seen, done
 
-        self._admit_fn = jax.jit(admit_fn)
+        self._place_fn = jax.jit(place_fn)
 
         # retire: freeze the slot AND rewind its pos/cache_index to 0, so a
         # frozen slot's continued (discarded) decode writes at position 0
@@ -231,7 +249,8 @@ class ContinuousBatcher:
 
     @property
     def pending(self) -> int:
-        return len(self._queue) + sum(s is not None for s in self._slots)
+        return (len(self._queue) + len(self._parked)
+                + sum(s is not None for s in self._slots))
 
     # ------------------------------------------------------------------
     def _prefill(self, ids):
@@ -264,48 +283,73 @@ class ContinuousBatcher:
             chunk >>= 1
         return logits, cache
 
-    def _admit(self):
-        """Admit queued requests into free slots.  Same-length prompts at
-        the queue head share ONE batched prefill (one compiled forward at
-        (B, chunk) instead of B serial B=1 prefills), so a burst of
-        arrivals no longer stacks k prefills onto the k-th TTFT — the
-        round-2 serial-admission weakness."""
-        free = [i for i in range(self.n_slots) if self._slots[i] is None]
-        while self._queue and free:
+    def _prefill_batch(self, max_new: int):
+        """Prefill up to ``max_new`` queued requests and PARK the results.
+
+        Same-length prompts at the queue head share ONE batched prefill
+        (one compiled forward at (B, chunk) instead of B serial B=1
+        prefills — the round-2 serial-admission fix); the first token is
+        sampled here, so TTFT lands NOW even if every slot is busy.  A
+        request finished by its first token (eos or max_new_tokens<=1)
+        completes without ever occupying a slot."""
+        while self._queue and max_new > 0:
             plen = len(self._queue[0].prompt)
             reqs = [self._queue.popleft()]
-            while (self._queue and len(reqs) < len(free)
+            while (self._queue and len(reqs) < max_new
                    and len(self._queue[0].prompt) == plen):
                 reqs.append(self._queue.popleft())
+            max_new -= len(reqs)
             ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
             logits, cacheB = self._prefill(ids)
             for row, req in enumerate(reqs):
-                i = free.pop(0)
                 cache1 = jax.tree_util.tree_map(
                     lambda l, bd: l if bd is None
                     else jax.lax.dynamic_slice_in_dim(l, row, 1, bd),
                     cacheB, self._cache_bdims)
-                # fixed shapes only reach the jitted admission: the
+                # fixed shapes only reach the jitted sampler: the
                 # last-token logits row and a HOST-built (1, V) prompt
                 # mask — so it compiles once across all prompt lengths
                 prompt_seen = np.zeros((1, self._vocab), bool)
                 prompt_seen[0, req.prompt] = True
-                (self._cache, self._token, self._pos, self._temp,
-                 self._top_p, self._rep, self._seen, self._done,
-                 first) = self._admit_fn(
-                    self._cache, self._token, self._pos, self._temp,
-                    self._top_p, self._rep, self._seen, self._done,
-                    cache1, logits[row:row + 1, -1, :],
-                    jnp.asarray(prompt_seen),
-                    len(req.prompt), req.uid, i,
-                    req.temperature, req.top_p, req.repetition_penalty)
+                first, seen1 = self._first_token_fn(
+                    logits[row:row + 1, -1, :], jnp.asarray(prompt_seen),
+                    req.uid, req.temperature, req.top_p,
+                    req.repetition_penalty)
                 first_host = int(jax.device_get(first)[0])
                 self._t_first[req.uid] = time.perf_counter()
-                done0 = first_host == self.eos or req.max_new_tokens <= 1
-                self._slots[i] = _Active(req, [first_host])
-                if done0:
-                    self._retire(i)
-                    free.append(i)
+                if first_host == self.eos or req.max_new_tokens <= 1:
+                    self._finish_unslotted(req, [first_host])
+                else:
+                    self._parked.append(
+                        (req, cache1, first, seen1, first_host))
+
+    def _finish_unslotted(self, req: Request, emitted: List[int]):
+        self._finished[req.uid] = np.concatenate(
+            [req.prompt, np.asarray(emitted, np.int32)])
+        t_sub = self._t_submit.pop(req.uid, None)
+        t_first = self._t_first.pop(req.uid, None)
+        if t_sub is not None:
+            now = time.perf_counter()
+            self._lat.append((t_first - t_sub if t_first is not None
+                              else float("nan"), now - t_sub))
+
+    def _admit(self):
+        """Place parked (already-prefilled) requests into free slots;
+        prefill directly for any remaining free capacity."""
+        free = [i for i in range(self.n_slots) if self._slots[i] is None]
+        if len(self._parked) < len(free):
+            self._prefill_batch(len(free) - len(self._parked))
+        while self._parked and free:
+            req, cache1, first, seen1, first_host = self._parked.popleft()
+            i = free.pop(0)
+            (self._cache, self._token, self._pos, self._temp,
+             self._top_p, self._rep, self._seen, self._done) = \
+                self._place_fn(
+                    self._cache, self._token, self._pos, self._temp,
+                    self._top_p, self._rep, self._seen, self._done,
+                    cache1, first, seen1, len(req.prompt), i,
+                    req.temperature, req.top_p, req.repetition_penalty)
+            self._slots[i] = _Active(req, [first_host])
 
     def _retire(self, i: int):
         act = self._slots[i]
@@ -324,30 +368,51 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------
     def step(self, ticks: int = 1) -> Dict[int, np.ndarray]:
-        """Admit queued requests, run ``ticks`` decode ticks for every
-        active slot (one host round-trip total), retire finished ones.
-        For the rest of a window, an EOS-finished slot emits pad (its
-        device ``done`` flag froze it); a slot finished by its
-        max_new_tokens count keeps computing real tokens on-device — the
-        host discards them and the slot's state is overwritten at the
-        next admission.  Returns {uid: full token array} for requests
-        that completed during this call."""
+        """Admit, decode up to ``ticks`` ticks, retire finished slots.
+
+        TTFT-oriented scheduling (round-3 verdict: requests waited out
+        whole windows, p50 TTFT = seconds): with waiters present the
+        window splits at the next CERTAIN retirement (a slot reaching its
+        max_new_tokens) so freed slots refill immediately, and queued
+        requests are prefilled ahead (``_prefill_batch``) so their first
+        token — the TTFT clock-stop — is produced while slots are still
+        busy.  Sub-window lengths round down to powers of two, so the
+        executable cache stays at log2(ticks) entries instead of one per
+        distinct remaining-token count (each compile costs seconds over a
+        tunneled link).  With no waiters the full window runs in one
+        round trip exactly as before — the idle-path throughput is
+        untouched.  EOS retirements are only observed at sub-window
+        boundaries (the done flag freezes the slot on device, so padding
+        is discarded, not mis-emitted).  Returns {uid: full token array}
+        for requests completed during this call."""
         if ticks < 1:
             raise ValueError(f"ticks must be >= 1, got {ticks}")
         before = set(self._finished)
-        self._admit()
-        if any(s is not None for s in self._slots):
+        remaining = int(ticks)
+        while remaining > 0:
+            self._admit()
+            if self.prefill_ahead and self._queue:
+                self._prefill_batch(self.prefill_ahead - len(self._parked))
+            active = [a for a in self._slots if a is not None]
+            if not active:
+                break
+            sub = remaining
+            if self._queue or self._parked:
+                t2r = min(a.req.max_new_tokens - len(a.emitted)
+                          for a in active)
+                sub = max(1, min(remaining, t2r))
+                sub = 1 << (sub.bit_length() - 1)   # pow2: bounded compiles
             slot_ids = jnp.arange(self.n_slots)
             toks, self._cache, self._token, self._pos, self._seen, done = \
-                self._multi_step(int(ticks))(
+                self._multi_step(int(sub))(
                     self.engine.params, self._cache, self._token, self._pos,
                     slot_ids, self._temp, self._top_p, self._rep, self._seen,
                     self._done, jnp.int32(self._tick_no), jnp.int32(self.eos),
                     jnp.int32(self.pad))
-            self._tick_no += int(ticks)
+            self._tick_no += int(sub)
             self._done = done
-            tok_h = np.asarray(jax.device_get(toks))[:, :, 0]  # (ticks, slots)
-            for t in range(int(ticks)):
+            tok_h = np.asarray(jax.device_get(toks))[:, :, 0]  # (sub, slots)
+            for t in range(int(sub)):
                 for i, act in enumerate(self._slots):
                     if act is None:
                         continue
@@ -356,6 +421,7 @@ class ContinuousBatcher:
                     if (self.eos >= 0 and tokv == self.eos) or \
                             len(act.emitted) >= act.req.max_new_tokens:
                         self._retire(i)
+            remaining -= int(sub)
         new = {u: self._finished[u] for u in self._finished if u not in before}
         return new
 
@@ -366,6 +432,22 @@ class ContinuousBatcher:
         while any(u not in self._finished for u in uids):
             self.step(ticks=ticks)
         return [self._finished[u] for u in uids]
+
+    def warmup_windows(self, ticks: int) -> None:
+        """AOT-compile every pow2 sub-window executable ≤ ``ticks``.
+
+        Sub-window scheduling picks pow2 window lengths; without this,
+        the first occurrence of each length compiles INSIDE the serving
+        path (seconds per compile on a tunneled device).  Feeds the XLA
+        compilation cache, so the serving-path jit resolves quickly."""
+        s = 1
+        while s <= int(ticks):
+            self._multi_step(s).lower(
+                self.engine.params, self._cache, self._token, self._pos,
+                jnp.arange(self.n_slots), self._temp, self._top_p,
+                self._rep, self._seen, self._done, jnp.int32(0),
+                jnp.int32(self.eos), jnp.int32(self.pad)).compile()
+            s <<= 1
 
     # ------------------------------------------------------------------
     def reset_latency_stats(self) -> None:

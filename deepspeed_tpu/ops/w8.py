@@ -48,10 +48,28 @@ def w8a16_matmul(x: jax.Array, codes: jax.Array, scale: jax.Array):
 
     x: (..., K) activation (bf16/fp32); codes: int8 (K, N); scale: fp32
     (G, N) with G | K.  Per-group partial products accumulate in fp32 and
-    the scale folds into the combine."""
+    the scale folds into the combine.
+
+    Decode-sized calls on TPU route to the Pallas panel kernel
+    (``ops/pallas/w8_matmul.py``): the einsum path's ``(…, G, N)`` fp32
+    partials in HBM cost more than the int8 read saves once weights
+    amortize across batched slots (round-3: −11% at batch 8)."""
     K, N = codes.shape
     G = scale.shape[0]
     g = K // G
+    from .attention import on_tpu
+
+    if on_tpu():
+        from .pallas.spmd import kernel_mesh_plan
+        from .pallas.w8_matmul import supported, w8a16_matmul_pallas
+
+        verdict, _ = kernel_mesh_plan(x.shape[0] if x.ndim else 1)
+        if verdict == "direct" and supported(x.shape, codes.shape, G,
+                                             mesh_ok=True):
+            M = int(np.prod(x.shape[:-1]))
+            y = w8a16_matmul_pallas(x.reshape(M, K).astype(jnp.bfloat16),
+                                    codes, scale)
+            return y.reshape(*x.shape[:-1], N).astype(x.dtype)
     cdt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.bfloat16
     xg = x.reshape(*x.shape[:-1], G, g)
     cg = codes.reshape(G, g, N)

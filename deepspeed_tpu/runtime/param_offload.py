@@ -516,10 +516,37 @@ class ParamOffloadRunner:
         z = np.load(os.path.join(d, f"param_offload_rank{rank}.npz"))
         saved = [tuple(r) for r in z["ranges"]]
         if saved != [tuple(r) for r in self._ranges]:
-            raise ValueError(
-                "param-offload checkpoint partition mismatch: saved "
-                f"{saved[:2]}… vs current {self._ranges[:2]}… — restore "
-                "on the same mesh topology")
+            # topology changed since save: merge EVERY rank's partitions
+            # into the full flat vectors and re-slice this process's
+            # ranges (zero_to_fp32-style elastic restore, reference
+            # utils/zero_to_fp32.py:362).  Needs all rank files visible
+            # (shared filesystem — same requirement as the reference).
+            log_dist(
+                f"param-offload restore: repartitioning {tag} "
+                f"(saved layout {saved[:2]}… → current "
+                f"{self._ranges[:2]}…)", ranks=[0])
+            cons = consolidate_offload_checkpoint(load_dir, tag)
+
+            def reslice(full: np.ndarray) -> np.ndarray:
+                out = np.zeros(self._gsz_p, np.float32)
+                n = min(full.size, self._gsz_p)
+                out[:n] = full[:n]       # padding tails are zeros
+                return np.concatenate([out[a:b] for a, b in self._ranges])
+
+            for g in range(self.G):
+                self._g_master[g][:] = reslice(cons["groups"][g]["master"])
+                self._g_opt[g].exp_avg[:] = reslice(cons["groups"][g]["m"])
+                self._g_opt[g].exp_avg_sq[:] = \
+                    reslice(cons["groups"][g]["v"])
+                self._g_opt[g].t = cons["t"]
+                self._refresh_mirror(g)
+            self._sh_master[:] = cons["sh_master"]
+            self._sh_opt.exp_avg[:] = cons["sh_m"]
+            self._sh_opt.exp_avg_sq[:] = cons["sh_v"]
+            self._sh_opt.t = cons["t"]
+            self.step_count = cons["step"]
+            self._shared_dev = self._place_shared()
+            return load_dir, cons["client_state"]
         z0 = np.load(os.path.join(d, "param_offload_rank0.npz"))
         self._sh_master[:] = z0["sh_master"]
         self._sh_opt.exp_avg[:] = z0["sh_m"]
@@ -567,3 +594,87 @@ class ParamOffloadRunner:
         h = jax.tree_util.tree_unflatten(
             self._h_def, [np.concatenate(ls, axis=0) for ls in h_leaves])
         return self._merge(shared, h)
+
+
+# ---------------------------------------------------------------------------
+# Offline consolidation — the ``zero_to_fp32.py`` analog for param-offload
+# checkpoints (reference ``utils/zero_to_fp32.py:362``
+# ``get_fp32_state_dict_from_zero_checkpoint`` reconstructs full fp32 state
+# from sharded optimizer checkpoints on ANY saved topology).
+# ---------------------------------------------------------------------------
+def consolidate_offload_checkpoint(ckpt_dir: str,
+                                   tag: Optional[str] = None) -> dict:
+    """Merge every ``param_offload_rank*.npz`` of a checkpoint into full
+    flat fp32 vectors, regardless of how many processes saved it.
+
+    Each rank file carries its global ``ranges`` into the padded flat
+    group vector plus its local partitions of master/exp_avg/exp_avg_sq;
+    the union of all ranks' ranges covers the vector, so the merge is a
+    pure scatter.  Returns ``{"groups": [{"master", "m", "v"}...],
+    "sh_master", "sh_m", "sh_v", "step", "t", "client_state"}``.  Use
+    :meth:`ParamOffloadRunner.load_checkpoint` to restore the result on a
+    different topology (it calls this on partition mismatch), or
+    :meth:`ParamOffloadRunner.host_params` after a restore for the full
+    fp32 parameter TREE."""
+    import glob as _glob
+    import pickle
+    import re as _re
+
+    if tag is None:
+        with open(os.path.join(ckpt_dir, "latest")) as fh:
+            tag = fh.read().strip()
+    d = os.path.join(ckpt_dir, tag)
+    files = _glob.glob(os.path.join(d, "param_offload_rank*.npz"))
+    if not files:
+        raise FileNotFoundError(f"no param_offload_rank*.npz under {d}")
+    files.sort(key=lambda p: int(
+        _re.search(r"rank(\d+)\.npz$", p).group(1)))
+    zs = [np.load(p) for p in files]
+    G = sum(1 for k in zs[0].files if _re.fullmatch(r"g\d+_master", k))
+    gsz_p = max(int(b) for z in zs for _, b in z["ranges"])
+
+    groups = [{k: np.zeros(gsz_p, np.float32) for k in ("master", "m", "v")}
+              for _ in range(G)]
+    for z in zs:
+        for g in range(G):
+            for key, name in (("master", f"g{g}_master"), ("m", f"g{g}_m"),
+                              ("v", f"g{g}_v")):
+                flat, off = z[name], 0
+                for a, b in z["ranges"]:
+                    a, b = int(a), int(b)
+                    groups[g][key][a:b] = flat[off:off + (b - a)]
+                    off += b - a
+    z0 = zs[0]
+    return {
+        "groups": groups,
+        "sh_master": np.asarray(z0["sh_master"], np.float32),
+        "sh_m": np.asarray(z0["sh_m"], np.float32),
+        "sh_v": np.asarray(z0["sh_v"], np.float32),
+        "step": int(z0["step"]), "t": int(z0["t"]),
+        "client_state": pickle.loads(z0["client_state"].tobytes())
+        if "client_state" in z0.files else {},
+    }
+
+
+def main():  # pragma: no cover - thin CLI
+    """``python -m deepspeed_tpu.runtime.param_offload <ckpt_dir> <out>``:
+    consolidate a param-offload checkpoint (any process count) into one
+    npz of full flat fp32 vectors — the offline ``zero_to_fp32`` flow."""
+    import sys
+
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__ and main.__doc__)
+    cons = consolidate_offload_checkpoint(sys.argv[1])
+    flat = {"step": np.int64(cons["step"]), "t": np.int64(cons["t"]),
+            "sh_master": cons["sh_master"], "sh_m": cons["sh_m"],
+            "sh_v": cons["sh_v"]}
+    for g, grp in enumerate(cons["groups"]):
+        flat[f"g{g}_master"] = grp["master"]
+        flat[f"g{g}_m"] = grp["m"]
+        flat[f"g{g}_v"] = grp["v"]
+    np.savez(sys.argv[2], **flat)
+    print(f"consolidated {len(cons['groups'])} groups -> {sys.argv[2]}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -355,3 +355,48 @@ def test_continuous_batcher_batched_admission_exact():
     outs_m = b2.run(mixed, max_new_tokens=4)
     for got, want in zip(outs_m, singles_m):
         np.testing.assert_array_equal(got, want)
+
+
+def test_continuous_batcher_prefill_ahead_ttft():
+    """Round-4 TTFT scheduling (VERDICT #3): with every slot busy, queued
+    requests still get prefilled and their FIRST token sampled (parked
+    until a slot frees) — the TTFT clock stops before the current wave
+    finishes decoding — and the final outputs stay exact."""
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    rng = np.random.default_rng(33)
+    prompts = [rng.integers(0, 512, size=(6,)).astype(np.int32)
+               for _ in range(4)]
+    singles = [np.asarray(eng.generate(p[None], max_new_tokens=10))[0]
+               for p in prompts]
+    batcher = ContinuousBatcher(eng, n_slots=2)
+    uids = [batcher.submit(p, max_new_tokens=10) for p in prompts]
+    # one short window: slots 0/1 are mid-decode, 2/3 queue-bound
+    batcher.step(ticks=2)
+    for u in uids[2:]:
+        assert u in batcher._t_first or u in batcher._finished, \
+            "queued request's first token not produced during busy window"
+    assert len(batcher._parked) == 2
+    while any(u not in batcher._finished for u in uids):
+        batcher.step(ticks=4)
+    for u, want in zip(uids, singles):
+        np.testing.assert_array_equal(batcher._finished[u], want)
+    stats = batcher.latency_stats()
+    assert stats["n"] == 4 and np.isfinite(stats["ttft_p90_s"])
+
+
+def test_continuous_batcher_subwindows_are_pow2():
+    """Sub-window scheduling must only compile pow2 window lengths (the
+    executable-count bound that keeps tunneled serving responsive)."""
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+    eng = _tiny_engine()
+    rng = np.random.default_rng(35)
+    prompts = [rng.integers(0, 512, size=(4,)).astype(np.int32)
+               for _ in range(5)]
+    b = ContinuousBatcher(eng, n_slots=2)
+    b.run(prompts, max_new_tokens=11, ticks=16)   # odd budget → odd t2r
+    compiled = [k[0] if isinstance(k, tuple) else k
+                for k in getattr(b._multi_step, "cache_parameters", lambda: None)() or []]
+    # lru_cache introspection differs by version; fall back to cache_info
+    n = b._multi_step.cache_info().currsize
+    assert n <= 5, f"too many sub-window executables: {n}"

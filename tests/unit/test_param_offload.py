@@ -131,3 +131,86 @@ def test_param_offload_config_validation():
             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": 1,
                                   "offload_param": {"device": "cpu"}}})
+
+
+def test_param_offload_consolidate_and_elastic_restore(tmp_path):
+    """zero_to_fp32 analog (VERDICT #6): a checkpoint saved under one
+    partition layout restores on a DIFFERENT layout — the per-rank npz
+    files are merged into full flat vectors and re-sliced.  Simulates a
+    2-process save by splitting the single-process rank file in two."""
+    from deepspeed_tpu.runtime.param_offload import (
+        consolidate_offload_checkpoint)
+
+    cfg_m = gpt2_config("gpt2-tiny", n_layer=4, scan_layers=True)
+    params = _host_params(GPT2LMHeadModel(cfg_m))
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg_m),
+        config=_cfg({"offload_param": {"device": "cpu"}}))
+    eng.init_params(params=params)
+    batch = token_batch(eng.train_batch_size, 16, 512, seed=3)
+    for _ in range(2):
+        eng.train_batch(batch)
+    run = eng._param_offload
+    d = eng.save_checkpoint(str(tmp_path), tag="t",
+                            client_state={"k": 7})
+
+    # rewrite the rank0 file as TWO fake ranks, splitting every range in
+    # half — the layout a 2-process (W/2 devices each) run would save
+    import os
+    z = np.load(os.path.join(d, "param_offload_rank0.npz"))
+    full_ranges = [tuple(map(int, r)) for r in z["ranges"]]
+    halves = [[], []]
+    for a, b in full_ranges:
+        mid = a + (b - a) // 2
+        halves[0].append((a, mid))
+        halves[1].append((mid, b))
+
+    def slices(flat, ranges):
+        out, off = [], 0
+        parts = []
+        for (a, b), (fa, fb) in zip(full_ranges, full_ranges):
+            parts.append((a, b, flat[off:off + (b - a)]))
+            off += b - a
+        for a, b in ranges:
+            for fa, fb, seg in parts:
+                if fa <= a and b <= fb:
+                    out.append(seg[a - fa:b - fa])
+                    break
+            else:
+                raise AssertionError("range not covered")
+        return np.concatenate(out)
+
+    G = sum(1 for k in z.files if k.startswith("g") and
+            k.endswith("_master"))
+    for rank, ranges in enumerate(halves):
+        arrs = {"ranges": np.asarray(ranges, np.int64),
+                "step": z["step"], "t": z["t"]}
+        for g in range(G):
+            for key in ("master", "m", "v"):
+                arrs[f"g{g}_{key}"] = slices(z[f"g{g}_{key}"], ranges)
+        if rank == 0:
+            for k in ("client_state", "sh_master", "sh_m", "sh_v"):
+                arrs[k] = z[k]
+        np.savez(os.path.join(d, f"param_offload_rank{rank}.npz"), **arrs)
+
+    # offline merge reproduces the full vectors
+    cons = consolidate_offload_checkpoint(str(tmp_path), tag="t")
+    assert cons["step"] == 2 and cons["client_state"] == {"k": 7}
+
+    # elastic restore: fresh single-process engine loads the 2-rank save
+    mesh_mod.set_mesh(None)
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg_m),
+        config=_cfg({"offload_param": {"device": "cpu"}}))
+    eng2.init_params(params=_host_params(GPT2LMHeadModel(cfg_m)))
+    _, client = eng2.load_checkpoint(str(tmp_path), tag="t")
+    assert client == {"k": 7}
+    # identical continued trajectory
+    l1 = float(eng.train_batch(batch))
+    l2 = float(eng2.train_batch(batch))
+    np.testing.assert_allclose(l2, l1, rtol=1e-5, atol=1e-6)
+    # and identical full fp32 master trees
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-7),
+        eng.  _param_offload.host_params(),
+        eng2._param_offload.host_params())
