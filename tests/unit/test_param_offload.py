@@ -157,7 +157,10 @@ def test_param_offload_consolidate_and_elastic_restore(tmp_path):
     # rewrite the rank0 file as TWO fake ranks, splitting every range in
     # half — the layout a 2-process (W/2 devices each) run would save
     import os
-    z = np.load(os.path.join(d, "param_offload_rank0.npz"))
+    # Eager-read: np.load is lazy and the loop below overwrites this very
+    # file, which would truncate the inode under the open handle.
+    with np.load(os.path.join(d, "param_offload_rank0.npz")) as zf:
+        z = {k: zf[k] for k in zf.files}
     full_ranges = [tuple(map(int, r)) for r in z["ranges"]]
     halves = [[], []]
     for a, b in full_ranges:
@@ -180,7 +183,7 @@ def test_param_offload_consolidate_and_elastic_restore(tmp_path):
                 raise AssertionError("range not covered")
         return np.concatenate(out)
 
-    G = sum(1 for k in z.files if k.startswith("g") and
+    G = sum(1 for k in z if k.startswith("g") and
             k.endswith("_master"))
     for rank, ranges in enumerate(halves):
         arrs = {"ranges": np.asarray(ranges, np.int64),
