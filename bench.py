@@ -96,7 +96,8 @@ def bench_decode():
         model.init(jax.random.PRNGKey(0),
                    np.zeros((1, 8), np.int32))["params"],
         is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
-    eng = deepspeed_tpu.init_inference(model=model, params=params)
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       max_tokens=192)   # 32+128 gen
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, size=(32,)).astype(np.int32)
                for _ in range(slots * 2)]
@@ -140,23 +141,34 @@ def bench_serving():
             model.init(jax.random.PRNGKey(0),
                        np.zeros((1, 8), np.int32))["params"],
             is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        # cache_len = prompt+generation budget (rounded to the lane tile),
+        # NOT the model's 1024 context: decode streams the whole static
+        # cache every tick, and the full-length cache was ~10 ms/tick of
+        # pure cache traffic at 760M (round-5 scaling probe)
         eng = deepspeed_tpu.init_inference(model=model, params=params,
-                                           quant=quant)
+                                           quant=quant, max_tokens=128)
         prompts = [rng.integers(0, cfg.vocab_size,
                                 size=(prompt_len,)).astype(np.int32)
                    for _ in range(slots * 2)]
         batcher = ContinuousBatcher(eng, n_slots=slots)
-        ticks = 16 if on_tpu else 4
+        # 64-tick windows: one whole generation wave per host round-trip
+        # (RTT ~130 ms dominates at 16 — round-5 scaling probe)
+        ticks = 64 if on_tpu else 4
         batcher.run(prompts[:slots], max_new_tokens=4, ticks=ticks)  # warm
         batcher.warmup_windows(ticks)   # pow2 sub-window executables
-        batcher.reset_latency_stats()   # keep compile-time TTFTs out
-        t0 = time.perf_counter()
-        outs = batcher.run(prompts, max_new_tokens=new_toks, ticks=ticks)
-        dt = time.perf_counter() - t0
-        tokens = sum(len(o) - prompt_len for o in outs)
-        lat = batcher.latency_stats()
+        # median of 3 bursts: one burst is ~1 s of wall clock on this
+        # chip and single-run noise swamped the int8-vs-fp margin (r5)
+        rates = []
+        for _ in range(3):
+            batcher.reset_latency_stats()   # keep compile-time TTFTs out
+            t0 = time.perf_counter()
+            outs = batcher.run(prompts, max_new_tokens=new_toks,
+                               ticks=ticks)
+            dt = time.perf_counter() - t0
+            rates.append(sum(len(o) - prompt_len for o in outs) / dt)
+        lat = batcher.latency_stats()       # last burst's TTFTs
         del eng, batcher
-        return {"decode_tok_s": round(tokens / dt, 1),
+        return {"decode_tok_s": round(statistics.median(rates), 1),
                 "ttft_p50_ms": round(1000 * lat["ttft_p50_s"], 1),
                 "ttft_p90_ms": round(1000 * lat["ttft_p90_s"], 1)}
 
@@ -207,21 +219,24 @@ def bench_moe_serving():
             model.init(jax.random.PRNGKey(0),
                        np.zeros((1, 8), np.int32))["params"],
             is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
-        eng = deepspeed_tpu.init_inference(model=model, params=params)
+        eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                           max_tokens=128)
         prompts = [rng.integers(0, cfg.vocab_size,
                                 size=(prompt_len,)).astype(np.int32)
                    for _ in range(slots)]
         b = ContinuousBatcher(eng, n_slots=slots)
         ticks = 16 if on_tpu else 4
         b.run(prompts, max_new_tokens=4, ticks=ticks)       # warm
-        t0 = time.perf_counter()
-        outs = b.run(prompts, max_new_tokens=new_toks, ticks=ticks)
-        dt = time.perf_counter() - t0
-        toks = sum(len(o) - prompt_len for o in outs)
+        rates = []
+        for _ in range(3):   # median: single ~1 s bursts are too noisy
+            t0 = time.perf_counter()
+            outs = b.run(prompts, max_new_tokens=new_toks, ticks=ticks)
+            dt = time.perf_counter() - t0
+            rates.append(sum(len(o) - prompt_len for o in outs) / dt)
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(params))
         del eng, b
-        return round(toks / dt, 1), n_params
+        return round(statistics.median(rates), 1), n_params
 
     moe_tok_s, moe_params = run(MoEConfig(num_experts=experts, top_k=1))
     dense_tok_s, dense_params = run(None)

@@ -129,26 +129,43 @@ class InferenceEngine:
         self._pos_field = pos_field
         model_limit = getattr(cfg, pos_field)
         requested = self.config.max_tokens
+        cache_kw = {}
         if requested and requested != model_limit and \
                 _params_depend_on(model, self.model_cfg, pos_field):
             # learned position table (GPT-2 wpe, BERT, GPT-Neo): resizing
-            # the field would reshape checkpoint params — cache stays at
-            # the model's length and max_tokens only caps generation
-            logger.warning(
-                f"max_tokens={requested} ignored for the cache: this model "
-                f"has learned position embeddings sized by {pos_field}="
-                f"{model_limit}; generation is capped at "
-                f"{min(requested, model_limit)}")
+            # the field would reshape checkpoint params — the POSITION
+            # table stays at the model's length; the KV cache shrinks via
+            # ``cache_len`` (decode streams the whole static cache every
+            # tick, so a 1024-slot cache for a 96-token generation costs
+            # ~10× the serving bandwidth it needs)
             self._gen_limit = min(requested, model_limit)
             decode_len = model_limit
+            if requested > model_limit:
+                logger.warning(
+                    f"max_tokens={requested} exceeds the learned position "
+                    f"table ({pos_field}={model_limit}); generation is "
+                    f"capped at {model_limit}")
+            if self._gen_limit < model_limit and \
+                    hasattr(self.model_cfg, "cache_len"):
+                cache_kw["cache_len"] = self._gen_limit
         else:
             # rotary-style models: the field only sizes the KV cache, so
             # max_tokens may shrink it (less HBM) or extend it past the
             # trained context
             decode_len = requested or model_limit
             self._gen_limit = decode_len
+        # a cache_len the CALLER set on the model config caps generation
+        # too — a 256-slot cache must not admit 2048-token sequences
+        # (clamped cache writes would silently corrupt decoding) — and
+        # wins over a larger max_tokens-derived cache size
+        user_cl = getattr(self.model_cfg, "cache_len", None)
+        if user_cl:
+            self._gen_limit = min(self._gen_limit, user_cl)
+            cache_kw["cache_len"] = min(
+                user_cl, cache_kw.get("cache_len", user_cl))
         self.decode_cfg = dataclasses.replace(
-            self.model_cfg, decode=True, **{pos_field: decode_len})
+            self.model_cfg, decode=True, **{pos_field: decode_len},
+            **cache_kw)
         self._fwd_model = type(model)(self.model_cfg)
         self._decode_model = type(model)(self.decode_cfg)
 
@@ -225,8 +242,26 @@ class InferenceEngine:
 
             unboxed = jax.tree_util.tree_map_with_path(_quant_leaf, unboxed)
             log_dist(f"quantized inference weights to {bits} bits", ranks=[0])
-        self.params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s), unboxed, shardings)
+
+        # store float params at the SERVING dtype (bf16 unless the caller
+        # set dtype=): decode is weight-bandwidth-bound, and fp32 storage
+        # + per-use casts read twice the bytes every tick (round-4 int8
+        # review found this on the fp path).  W8 scales (``*_s``) stay
+        # fp32 — the dequant combine needs them full width.
+        store = self.model_cfg.dtype
+
+        # cast + shard leaf-by-leaf: casting the whole tree eagerly first
+        # would materialize a full unsharded copy on the default device
+        # (OOM for models that only fit TP-sharded)
+        def _put(path, x, s):
+            dt = np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype
+            cast = jnp.issubdtype(dt, jnp.floating) and \
+                not getattr(path[-1], "key", "").endswith("_s")
+            return jax.device_put(
+                jnp.asarray(x, store) if cast else jnp.asarray(x), s)
+
+        self.params = jax.tree_util.tree_map_with_path(
+            _put, unboxed, shardings)
         n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
         log_dist(f"inference params loaded: {n/1e6:.1f}M, mp={self.mesh.shape['tp']}",
                  ranks=[0])
@@ -335,15 +370,30 @@ class InferenceEngine:
         return jnp.zeros((B, vocab_size), bool).at[
             jnp.arange(B)[:, None], input_ids].set(True)
 
-    def init_cache(self, batch_size: int):
+    def _zero_cache_fn(self, batch_size: int):
+        """Memoized (per batch width) jitted zero-cache builder: the naive
+        path re-traced the whole model (``eval_shape``) and dispatched one
+        ``jnp.zeros`` per cache leaf on EVERY admission — ~300 ms of pure
+        host/tunnel overhead per prefill batch at 24 unrolled layers.
+        The memo is per-INSTANCE (not an lru_cache keyed by self, which
+        would pin retired engines — and their HBM params — alive)."""
+        memo = self.__dict__.setdefault("_zero_cache_memo", {})
+        if batch_size in memo:
+            return memo[batch_size]
         dummy = jnp.zeros((batch_size, 1), jnp.int32)
         vars_ = jax.eval_shape(
             lambda r: self._decode_model.init(r, dummy,
                                               position_ids=jnp.zeros((1, 1), jnp.int32)),
             jax.random.PRNGKey(0))
-        cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), vars_["cache"])
-        return cache
+        leaves, treedef = jax.tree_util.tree_flatten(vars_["cache"])
+        fn = jax.jit(lambda: tuple(jnp.zeros(l.shape, l.dtype)
+                                   for l in leaves))
+        memo[batch_size] = (fn, treedef)
+        return fn, treedef
+
+    def init_cache(self, batch_size: int):
+        fn, treedef = self._zero_cache_fn(batch_size)
+        return jax.tree_util.tree_unflatten(treedef, fn())
 
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,

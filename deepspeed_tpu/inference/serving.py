@@ -127,31 +127,42 @@ class ContinuousBatcher:
         # params are an explicit broadcast argument (in_axes=None), NOT a
         # closure capture: captured arrays serialize as literals in the
         # compile payload (fatal over a remote-compile tunnel at 124M+)
-        def slot_step(params, cache, token, pos, slot_id, temp, top_p, rep,
-                      seen, done, tick, eos, pad):
-            key = jax.random.fold_in(
-                jax.random.fold_in(jax.random.PRNGKey(base_seed), tick), slot_id)
-            out, vars_ = decode_model.apply(
-                {"params": params, "cache": cache}, token,
-                position_ids=jnp.full((1, 1), pos, jnp.int32),
-                mutable=["cache"])
-            logits = out["logits"][:, -1, :].astype(jnp.float32)   # (1, V)
-            nxt = _sample(logits, key, temp, top_k_static, top_p, rep, seen)
-            nxt = jnp.where(done, pad, nxt)
-            new_done = jnp.logical_or(done, nxt == eos)
-            seen = seen.at[jnp.arange(1), nxt].set(True)
-            return nxt, vars_["cache"], seen, new_done
+        def make_slot_step(greedy: bool):
+            def slot_step(params, cache, token, pos, slot_id, temp, top_p,
+                          rep, seen, done, tick, eos, pad):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(base_seed), tick),
+                    slot_id)
+                out, vars_ = decode_model.apply(
+                    {"params": params, "cache": cache}, token,
+                    position_ids=jnp.full((1, 1), pos, jnp.int32),
+                    mutable=["cache"])
+                logits = out["logits"][:, -1, :].astype(jnp.float32)  # (1,V)
+                # greedy pools take the STATIC temperature=0 sampler: with
+                # traced temp/top_p the nucleus path stays live and costs a
+                # (V,)-sort per slot per tick — ~10 ms/tick of pure dead
+                # code at 8×50k vocab when every request is greedy anyway
+                nxt = _sample(logits, key, 0.0 if greedy else temp,
+                              top_k_static, 1.0 if greedy else top_p,
+                              rep, seen)
+                nxt = jnp.where(done, pad, nxt)
+                new_done = jnp.logical_or(done, nxt == eos)
+                seen = seen.at[jnp.arange(1), nxt].set(True)
+                return nxt, vars_["cache"], seen, new_done
+            return slot_step
 
-        self._vmapped_step = jax.vmap(
-            slot_step,
-            in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None))
+        self._vmapped_steps = {
+            greedy: jax.vmap(
+                make_slot_step(greedy),
+                in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None, None))
+            for greedy in (False, True)}
 
         # N ticks per host round-trip: a lax.scan over the vmapped tick,
         # emitting (ticks, slots) tokens in ONE device fetch — the lever
         # for high-RTT links where each sync costs a round trip
         @functools.lru_cache(maxsize=None)   # executables are cheap vs a
-        def multi_step(ticks: int):          # recompile on tunneled links
-            vstep = self._vmapped_step
+        def multi_step(ticks: int, greedy: bool = False):
+            vstep = self._vmapped_steps[greedy]
 
             def run(params, cache, token, pos, slot_ids, temp, top_p, rep,
                     seen, done, tick0, eos, pad):
@@ -186,11 +197,27 @@ class ContinuousBatcher:
             seen1 = prompt_seen.at[jnp.arange(1), first].set(True)
             return first, seen1
 
-        self._first_token_fn = jax.jit(first_token_fn)
+        # one executable per batch width; a per-ROW jit + device_get costs
+        # one tunnel round-trip per request (round-4: ~1.4 s of the 1.8 s
+        # TTFT was 8 sequential syncs) — the batch samples in ONE call and
+        # the caller fetches every first token in ONE device_get
+        self._first_token_batch = jax.jit(jax.vmap(first_token_fn))
+
+        cache_bdims = self._cache_bdims
 
         def place_fn(cache, token, pos, temp, top_p, rep, seen, done,
-                     cache1, first, seen1, prompt_len, i,
+                     cacheB, firstB, seen1B, row, prompt_len, i,
                      r_temp, r_top_p, r_rep):
+            # row-extraction happens HERE, inside the jit: slicing the
+            # parked batch eagerly costs one tunneled dispatch per cache
+            # leaf per request (round-4: ~0.5 s of every prefill batch)
+            cache1 = jax.tree_util.tree_map(
+                lambda l, bd: l if bd is None
+                else jax.lax.dynamic_slice_in_dim(l, row, 1, bd),
+                cacheB, cache_bdims)
+            first = jax.lax.dynamic_slice_in_dim(firstB, row, 1, 0)[0]
+            seen1 = jax.lax.dynamic_slice_in_dim(seen1B, row, 1, 0)[0]
+
             def put(big, small):
                 return jax.lax.dynamic_update_slice(
                     big, small[None].astype(big.dtype),
@@ -210,8 +237,10 @@ class ContinuousBatcher:
 
         # retire: freeze the slot AND rewind its pos/cache_index to 0, so a
         # frozen slot's continued (discarded) decode writes at position 0
-        # instead of marching past the cache length — correctness no longer
-        # leans on dynamic_update_slice index clamping.  ``i`` is traced
+        # instead of marching past the cache length.  (Round-up sub-windows
+        # can still overshoot a not-yet-retired slot past its budget; those
+        # writes clamp at the cache edge and touch only the slot's own
+        # finished row, which placement overwrites.)  ``i`` is traced
         # (python int → weak scalar), so one executable serves every slot.
         def retire_fn(done, pos, cache, i):
             done = done.at[i, 0].set(True)
@@ -299,29 +328,34 @@ class ContinuousBatcher:
                    and len(self._queue[0].prompt) == plen):
                 reqs.append(self._queue.popleft())
             max_new -= len(reqs)
+            B = len(reqs)
             ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
             logits, cacheB = self._prefill(ids)
+            # fixed shapes only reach the jitted sampler: the last-token
+            # logits rows and a HOST-built (B, 1, V) prompt mask — so it
+            # compiles once per batch width across all prompt lengths
+            prompt_seen = np.zeros((B, 1, self._vocab), bool)
             for row, req in enumerate(reqs):
-                cache1 = jax.tree_util.tree_map(
-                    lambda l, bd: l if bd is None
-                    else jax.lax.dynamic_slice_in_dim(l, row, 1, bd),
-                    cacheB, self._cache_bdims)
-                # fixed shapes only reach the jitted sampler: the
-                # last-token logits row and a HOST-built (1, V) prompt
-                # mask — so it compiles once across all prompt lengths
-                prompt_seen = np.zeros((1, self._vocab), bool)
-                prompt_seen[0, req.prompt] = True
-                first, seen1 = self._first_token_fn(
-                    logits[row:row + 1, -1, :], jnp.asarray(prompt_seen),
-                    req.uid, req.temperature, req.top_p,
-                    req.repetition_penalty)
-                first_host = int(jax.device_get(first)[0])
-                self._t_first[req.uid] = time.perf_counter()
+                prompt_seen[row, 0, req.prompt] = True
+            firstB, seen1B = self._first_token_batch(
+                logits[:, -1:, :], jnp.asarray(prompt_seen),
+                jnp.asarray([r.uid for r in reqs], jnp.int32),
+                jnp.asarray([r.temperature for r in reqs], jnp.float32),
+                jnp.asarray([r.top_p for r in reqs], jnp.float32),
+                jnp.asarray([r.repetition_penalty for r in reqs],
+                            jnp.float32))
+            first_hostB = np.asarray(jax.device_get(firstB))[:, 0]
+            t_first = time.perf_counter()
+            for row, req in enumerate(reqs):
+                self._t_first[req.uid] = t_first
+                first_host = int(first_hostB[row])
                 if first_host == self.eos or req.max_new_tokens <= 1:
                     self._finish_unslotted(req, [first_host])
-                else:
-                    self._parked.append(
-                        (req, cache1, first, seen1, first_host))
+                    continue
+                # park the WHOLE batch by reference; _place_fn slices the
+                # row on device (no eager per-row dispatches here)
+                self._parked.append(
+                    (req, cacheB, row, firstB, seen1B, first_host))
 
     def _finish_unslotted(self, req: Request, emitted: List[int]):
         self._finished[req.uid] = np.concatenate(
@@ -340,14 +374,15 @@ class ContinuousBatcher:
         if len(self._parked) < len(free):
             self._prefill_batch(len(free) - len(self._parked))
         while self._parked and free:
-            req, cache1, first, seen1, first_host = self._parked.popleft()
+            req, cacheB, row, firstB, seen1B, first_host = \
+                self._parked.popleft()
             i = free.pop(0)
             (self._cache, self._token, self._pos, self._temp,
              self._top_p, self._rep, self._seen, self._done) = \
                 self._place_fn(
                     self._cache, self._token, self._pos, self._temp,
                     self._top_p, self._rep, self._seen, self._done,
-                    cache1, first, seen1, len(req.prompt), i,
+                    cacheB, firstB, seen1B, row, len(req.prompt), i,
                     req.temperature, req.top_p, req.repetition_penalty)
             self._slots[i] = _Active(req, [first_host])
 
@@ -401,10 +436,24 @@ class ContinuousBatcher:
                 t2r = min(a.req.max_new_tokens - len(a.emitted)
                           for a in active)
                 sub = max(1, min(remaining, t2r))
-                sub = 1 << (sub.bit_length() - 1)   # pow2: bounded compiles
+                if sub & (sub - 1):
+                    # pow2 windows keep the executable cache bounded; round
+                    # UP, not down: overshoot ticks decode discarded pads
+                    # (~ms each) while every extra window costs a full
+                    # host round-trip (~130 ms on the tunneled chip —
+                    # rounding 63 down fragmented it into six windows).
+                    # Cap at the largest pow2 <= remaining so every window
+                    # stays a warmed-up pow2 executable.  A slot past its
+                    # max_new_tokens keeps decoding until the boundary;
+                    # its cache writes clamp at the cache edge, corrupting
+                    # only its own finished (discarded) row, which
+                    # placement fully overwrites.
+                    sub = min(1 << sub.bit_length(),
+                              1 << (remaining.bit_length() - 1))
             slot_ids = jnp.arange(self.n_slots)
+            greedy = all(a.req.temperature <= 0.0 for a in active)
             toks, self._cache, self._token, self._pos, self._seen, done = \
-                self._multi_step(int(sub))(
+                self._multi_step(int(sub), greedy)(
                     self.engine.params, self._cache, self._token, self._pos,
                     slot_ids, self._temp, self._top_p, self._rep, self._seen,
                     self._done, jnp.int32(self._tick_no), jnp.int32(self.eos),
@@ -433,16 +482,20 @@ class ContinuousBatcher:
             self.step(ticks=ticks)
         return [self._finished[u] for u in uids]
 
-    def warmup_windows(self, ticks: int) -> None:
+    def warmup_windows(self, ticks: int, greedy: bool = True) -> None:
         """AOT-compile every pow2 sub-window executable ≤ ``ticks``.
 
         Sub-window scheduling picks pow2 window lengths; without this,
         the first occurrence of each length compiles INSIDE the serving
         path (seconds per compile on a tunneled device).  Feeds the XLA
-        compilation cache, so the serving-path jit resolves quickly."""
+        compilation cache, so the serving-path jit resolves quickly.
+        ``greedy`` picks the sampler variant to warm (the all-greedy pool
+        executable by default; a pool with any sampled request lazily
+        compiles the general variant on first use — call again with
+        ``greedy=False`` to pre-warm it too)."""
         s = 1
         while s <= int(ticks):
-            self._multi_step(s).lower(
+            self._multi_step(s, greedy).lower(
                 self.engine.params, self._cache, self._token, self._pos,
                 jnp.arange(self.n_slots), self._temp, self._top_p,
                 self._rep, self._seen, self._done, jnp.int32(0),

@@ -31,6 +31,11 @@ from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift
 class GPT2Config:
     vocab_size: int = 50257
     n_positions: int = 1024
+    # decode KV-cache length override: serving with a short
+    # generation limit must not pay full-context cache traffic
+    # every tick (the cache, not the weights, dominated decode
+    # bandwidth at 760M/1024-ctx).  None: the position field.
+    cache_len: Optional[int] = None
     n_embd: int = 768
     n_layer: int = 12
     n_head: int = 12
@@ -193,10 +198,11 @@ class SelfAttention(nn.Module):
             # (reference csrc/transformer/inference/csrc/softmax.cu keeps
             # triangular-masked history; here it's a mutable 'cache'
             # collection updated in place, static max length)
+            CL = cfg.cache_len or cfg.n_positions
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, cfg.n_positions, H, D), cfg.dtype)
+                               (B, CL, H, D), cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, cfg.n_positions, H, D), cfg.dtype)
+                               (B, CL, H, D), cfg.dtype)
             idx = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
             cur = idx.value

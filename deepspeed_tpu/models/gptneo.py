@@ -25,6 +25,11 @@ from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift
 class GPTNeoConfig:
     vocab_size: int = 50257
     max_position_embeddings: int = 2048
+    # decode KV-cache length override: serving with a short
+    # generation limit must not pay full-context cache traffic
+    # every tick (the cache, not the weights, dominated decode
+    # bandwidth at 760M/1024-ctx).  None: the position field.
+    cache_len: Optional[int] = None
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
@@ -138,10 +143,11 @@ class NeoAttention(nn.Module):
                    module=self, bias=False).reshape(B, S, H, D)
 
         if cfg.decode:
+            CL = cfg.cache_len or cfg.max_position_embeddings
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+                               (B, CL, H, D), cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+                               (B, CL, H, D), cfg.dtype)
             idx = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
             cur = idx.value

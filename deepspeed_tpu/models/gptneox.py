@@ -25,6 +25,11 @@ from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift
 class GPTNeoXConfig:
     vocab_size: int = 50432
     max_position_embeddings: int = 2048
+    # decode KV-cache length override: serving with a short
+    # generation limit must not pay full-context cache traffic
+    # every tick (the cache, not the weights, dominated decode
+    # bandwidth at 760M/1024-ctx).  None: the position field.
+    cache_len: Optional[int] = None
     hidden_size: int = 768
     num_hidden_layers: int = 12
     num_attention_heads: int = 12
@@ -133,10 +138,11 @@ class NeoXAttention(nn.Module):
         q, k = apply_rotary_pos_emb(q, k, position_ids, cfg.rotary_dim,
                                     cfg.rotary_emb_base)
         if cfg.decode:
+            CL = cfg.cache_len or cfg.max_position_embeddings
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+                               (B, CL, H, D), cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+                               (B, CL, H, D), cfg.dtype)
             idx = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
             cur = idx.value

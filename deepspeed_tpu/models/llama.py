@@ -24,6 +24,11 @@ from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift
 class LlamaConfig:
     vocab_size: int = 32000
     max_position_embeddings: int = 2048
+    # decode KV-cache length override: serving with a short
+    # generation limit must not pay full-context cache traffic
+    # every tick (the cache, not the weights, dominated decode
+    # bandwidth at 760M/1024-ctx).  None: the position field.
+    cache_len: Optional[int] = None
     hidden_size: int = 2048
     num_hidden_layers: int = 16
     num_attention_heads: int = 16
@@ -124,10 +129,11 @@ class LlamaAttention(nn.Module):
         q, k = apply_rotary_pos_emb(q, k, position_ids, rotary_dim=D,
                                     theta=cfg.rope_theta)
         if cfg.decode:
+            CL = cfg.cache_len or cfg.max_position_embeddings
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, cfg.max_position_embeddings, KV, D), cfg.dtype)
+                               (B, CL, KV, D), cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, cfg.max_position_embeddings, KV, D), cfg.dtype)
+                               (B, CL, KV, D), cfg.dtype)
             idx = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
             cur = idx.value

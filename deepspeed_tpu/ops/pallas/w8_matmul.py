@@ -104,6 +104,7 @@ def supported(x_shape, codes_shape, n_groups: int, mesh_ok: bool) -> bool:
     return (mesh_ok and K % 128 == 0 and N % 128 == 0
             and _pick_bn(N) != 0
             and (n_groups == 1 or g % 128 == 0) and K % max(g, 1) == 0
-            and M <= 256)    # decode regime (VMEM: x rows + one int8
-                             # panel); big compute-bound prefills keep
-                             # the einsum path
+            and M <= 64)     # decode regime only (batched slots fold to
+                             # M = n_slots); prefill rows are compute-
+                             # bound and the XLA grouped einsum beat the
+                             # panel kernel ~2x there (round-5 probe)

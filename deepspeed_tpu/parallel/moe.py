@@ -43,9 +43,14 @@ class MoEConfig:
     use_residual: bool = False          # PR-MoE (layer.py:106)
 
 
-def _capacity(num_tokens: int, num_experts: int, factor: float, min_capacity: int) -> int:
+def _capacity(num_tokens: int, num_experts: int, factor: float, min_capacity: int,
+              top_k: int = 1) -> int:
     cap = int(num_tokens * factor / num_experts)
-    return max(cap, min_capacity)
+    cap = max(cap, min_capacity)
+    # an expert's queue can never exceed S*k entries, so any capacity
+    # beyond that is pure padding — at S=1 decode the min_capacity floor
+    # would otherwise 4x every expert matmul for no semantic difference
+    return min(cap, num_tokens * top_k)
 
 
 def _one_hot(x, n):
@@ -129,7 +134,7 @@ class TopKGate(nn.Module):
     model_dim: int
 
     @nn.compact
-    def __call__(self, x: jax.Array, train: bool):
+    def __call__(self, x: jax.Array, train: bool, decode_fast: bool = False):
         cfg = self.cfg
         wg = self.param("wg", nn.with_partitioning(
             nn.initializers.normal(0.02), ("embed", "experts_gate")),
@@ -139,9 +144,30 @@ class TopKGate(nn.Module):
             rng = self.make_rng("gating")
             xf = xf * jax.random.uniform(rng, xf.shape, minval=0.98, maxval=1.02)
         logits = xf @ wg
+        if decode_fast:
+            # decode path (the Tutel fast-dispatch analog, reference
+            # sharded_moe.py:501): no capacity queues at a handful of
+            # decode tokens — just top-k indices + renormalized gates,
+            # consumed by the gathered-expert matmul in MoELayer
+            gates = jax.nn.softmax(logits, axis=-1)               # (S, E)
+            idx1 = jnp.argmax(gates, axis=-1)
+            if cfg.top_k == 1:
+                idx = idx1[:, None]                               # (S, 1)
+                w = jnp.ones_like(idx, jnp.float32) * \
+                    jnp.take_along_axis(gates, idx, axis=-1)
+            else:
+                g_wo1 = jnp.where(_one_hot(idx1, cfg.num_experts) > 0,
+                                  -jnp.inf, logits)
+                idx2 = jnp.argmax(g_wo1, axis=-1)
+                idx = jnp.stack([idx1, idx2], axis=-1)            # (S, 2)
+                w = jnp.take_along_axis(gates, idx, axis=-1)
+                w = w / jnp.maximum(w.sum(-1, keepdims=True),
+                                    jnp.finfo(jnp.float32).eps)
+            return jnp.float32(0.0), idx.astype(jnp.int32), w
         S = logits.shape[0]
         factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
-        capacity = _capacity(S, cfg.num_experts, factor, cfg.min_capacity)
+        capacity = _capacity(S, cfg.num_experts, factor, cfg.min_capacity,
+                             cfg.top_k)
         rng = self.make_rng("gating") if (train and cfg.noisy_gate_policy == "RSample") else None
         if cfg.top_k == 1:
             return top1_gating(logits, capacity, rng, cfg.noisy_gate_policy)
@@ -162,7 +188,14 @@ class ExpertsMLP(nn.Module):
     w8_group: int = 128
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:   # (E, C, M)
+    def __call__(self, x: jax.Array, idx: Optional[jax.Array] = None,
+                 gate_w: Optional[jax.Array] = None) -> jax.Array:
+        # (E, C, M) capacity-padded batch, or — when ``idx``/``gate_w``
+        # are given — the gathered decode path: x (S, M), idx (S, k)
+        # expert ids, gate_w (S, k) renormalized gates (Tutel-style fast
+        # dispatch, reference sharded_moe.py:501 + moe_inference.py).
+        # Param declarations are IDENTICAL on both paths, so one trained
+        # tree serves both.
         if self.w8:
             from ..ops.w8 import w8a16_expert_matmul
 
@@ -182,6 +215,10 @@ class ExpertsMLP(nn.Module):
                                  ("experts", "embed", "mlp"))
             wo_q, wo_s = qparams("wo", self.hidden_dim, self.model_dim,
                                  ("experts", "mlp", "embed"))
+            if idx is not None:
+                return self._gathered(x, idx, gate_w,
+                                      lambda f: self._w8_ffn(
+                                          f, wi_q, wi_s, wo_q, wo_s))
             h = nn.gelu(w8a16_expert_matmul(x, wi_q, wi_s),
                         approximate=True)
             return w8a16_expert_matmul(h, wo_q, wo_s)
@@ -191,9 +228,54 @@ class ExpertsMLP(nn.Module):
         wo = self.param("wo", nn.with_partitioning(
             nn.initializers.normal(0.02), ("experts", "mlp", "embed")),
             (self.num_experts, self.hidden_dim, self.model_dim), self.param_dtype)
+        if idx is not None:
+            def ffn(flat):
+                wi_g = jnp.take(wi, flat, axis=0).astype(self.dtype)
+                wo_g = jnp.take(wo, flat, axis=0).astype(self.dtype)
+                def apply(xr):   # (Sk, M) → (Sk, M)
+                    h = nn.gelu(jnp.einsum("sm,smh->sh", xr, wi_g),
+                                approximate=True)
+                    return jnp.einsum("sh,shm->sm", h, wo_g)
+                return apply
+            return self._gathered(x, idx, gate_w, ffn)
         h = jnp.einsum("ecm,emh->ech", x, wi.astype(self.dtype))
         h = nn.gelu(h, approximate=True)
         return jnp.einsum("ech,ehm->ecm", h, wo.astype(self.dtype))
+
+    def _gathered(self, x, idx, gate_w, make_apply):
+        """Run each token through its own top-k experts: one vecmat per
+        (token, choice) over gathered weight panels — S·k FFN rows instead
+        of the E·C capacity-padded batch (32× fewer at 8-slot top-1
+        decode)."""
+        S, k = idx.shape
+        flat = idx.reshape(-1)                          # (S*k,)
+        xr = jnp.repeat(x, k, axis=0)                   # (S*k, M)
+        o = make_apply(flat)(xr)                        # (S*k, M)
+        o = o.reshape(S, k, self.model_dim)
+        return (o * gate_w[..., None].astype(o.dtype)).sum(axis=1)
+
+    def _w8_ffn(self, flat, wi_q, wi_s, wo_q, wo_s):
+        """Gathered int8 expert FFN: per-token code panels dequantized in
+        the grouped contraction (never a full-width weight in HBM)."""
+        wi_qg = jnp.take(wi_q, flat, axis=0)            # (Sk, M, H) int8
+        wi_sg = jnp.take(wi_s, flat, axis=0)            # (Sk, G, H)
+        wo_qg = jnp.take(wo_q, flat, axis=0)
+        wo_sg = jnp.take(wo_s, flat, axis=0)
+
+        def one(xr, cq, cs):                            # (Sk, K) tokens
+            K, N = cq.shape[1], cq.shape[2]
+            G = cs.shape[1]
+            g = K // G
+            xg = xr.reshape(-1, G, g)
+            cg = cq.reshape(-1, G, g, N).astype(self.dtype)
+            part = jnp.einsum("sug,sugn->sun", xg.astype(self.dtype), cg)
+            return jnp.einsum("sun,sun->sn", part.astype(jnp.float32),
+                              cs).astype(self.dtype)
+
+        def apply(xr):
+            h = nn.gelu(one(xr, wi_qg, wi_sg), approximate=True)
+            return one(h, wo_qg, wo_sg)
+        return apply
 
 
 class MoELayer(nn.Module):
@@ -217,16 +299,36 @@ class MoELayer(nn.Module):
         cfg = self.cfg
         orig_shape = x.shape
         x2 = x.reshape(-1, self.model_dim)                        # (S, M)
-        l_aux, combine, dispatch = TopKGate(cfg, self.model_dim, name="gate")(x2, train)
-
-        dispatched = jnp.einsum("sec,sm->ecm", dispatch.astype(self.dtype), x2)
-        dispatched = _constrain_ep(dispatched)                    # all-to-all in
-        expert_out = ExpertsMLP(cfg.num_experts, self.model_dim, self.hidden_dim,
-                                dtype=self.dtype, w8=self.w8,
-                                w8_group=self.w8_group,
-                                name="experts")(dispatched)
-        expert_out = _constrain_ep(expert_out)                    # all-to-all out
-        out = jnp.einsum("sec,ecm->sm", combine.astype(self.dtype), expert_out)
+        experts = ExpertsMLP(cfg.num_experts, self.model_dim,
+                             self.hidden_dim, dtype=self.dtype, w8=self.w8,
+                             w8_group=self.w8_group, name="experts")
+        mesh = mesh_lib.get_mesh(required=False)
+        ep1 = mesh is None or mesh.shape.get("ep", 1) == 1
+        import os
+        fast_ok = os.environ.get("DS_TPU_MOE_FAST", "0") == "1"
+        if not train and ep1 and fast_ok and x2.shape[0] <= 32:
+            # gathered per-token experts (no capacity padding, no dispatch
+            # one-hots).  OPT-IN: on TPU the vmapped gather materializes a
+            # per-token copy of each expert panel in HBM and LOSES ~25% to
+            # the weight-stationary einsum at 8-slot decode (round-5 A/B);
+            # the einsum path with the S*k capacity cap is the default.
+            # Only without ep sharding — sharded experts want tokens moved
+            # to weights (all-to-all), not weight panels gathered to
+            # tokens.
+            l_aux, idx, gate_w = TopKGate(cfg, self.model_dim,
+                                          name="gate")(x2, train,
+                                                       decode_fast=True)
+            out = experts(x2, idx=idx, gate_w=gate_w)
+        else:
+            l_aux, combine, dispatch = TopKGate(
+                cfg, self.model_dim, name="gate")(x2, train)
+            dispatched = jnp.einsum("sec,sm->ecm",
+                                    dispatch.astype(self.dtype), x2)
+            dispatched = _constrain_ep(dispatched)            # all-to-all in
+            expert_out = experts(dispatched)
+            expert_out = _constrain_ep(expert_out)            # all-to-all out
+            out = jnp.einsum("sec,ecm->sm", combine.astype(self.dtype),
+                             expert_out)
 
         if cfg.use_residual:
             # PR-MoE: dense MLP branch + learned 2-way mix (layer.py:106-125)

@@ -1,0 +1,68 @@
+"""Round-5: split decode window cost into fixed (RTT/dispatch) vs
+per-tick (on-device) by timing multi_step(s) across window sizes.
+
+Also compares W8 impls e2e by forcing DS_TPU_W8_IMPL before build.
+Run: python scripts/probe_decode_scaling.py [fp|int8] [impl]
+"""
+import os
+import sys
+import time
+
+impl = sys.argv[2] if len(sys.argv) > 2 else None
+if impl:
+    os.environ["DS_TPU_W8_IMPL"] = impl
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.inference.serving import ContinuousBatcher  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+PRESET, SLOTS, PLEN = "gpt2-760m", 8, 32
+
+
+def main(quant):
+    npos = int(os.environ.get("PROBE_NPOS", "0"))
+    cfg = gpt2_config(PRESET, **({"n_positions": npos} if npos else {}))
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       quant=quant)
+    rng = np.random.default_rng(0)
+    b = ContinuousBatcher(eng, n_slots=SLOTS)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
+               for _ in range(SLOTS)]
+    b.run(prompts, max_new_tokens=4, ticks=16)   # warm prefill+decode
+
+    # occupy all slots with long-running requests so step() never admits
+    for p in prompts:
+        b.submit(p, max_new_tokens=min(4096 // SLOTS, eng._gen_limit - PLEN - 8))
+    b.step(ticks=1)
+
+    args = lambda: (eng.params, b._cache, b._token, b._pos,  # noqa: E731
+                    jnp.arange(SLOTS), b._temp, b._top_p, b._rep, b._seen,
+                    b._done, jnp.int32(b._tick_no), jnp.int32(-1),
+                    jnp.int32(0))
+    for s in (1, 2, 4, 8, 16, 32, 64):
+        f = b._multi_step(s, True)
+        out = f(*args())          # compile+run once
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        n = 4
+        for _ in range(n):
+            out = f(*args())
+            jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / n
+        print(f"window={s:3d}  {dt*1e3:8.2f} ms  {dt/s*1e3:7.2f} ms/tick  "
+              f"{SLOTS*s/dt:8.1f} tok/s", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "fp"
+    main({} if which == "fp" else {"enabled": True, "bits": 8})

@@ -1,0 +1,64 @@
+"""A/B the MoE decode fast path (gathered experts) vs einsum dispatch on
+the real chip, bench shapes.  Run: python scripts/probe_moe_decode.py"""
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.inference.serving import ContinuousBatcher  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+from deepspeed_tpu.parallel.moe import MoEConfig  # noqa: E402
+from deepspeed_tpu.comm import mesh as mesh_mod  # noqa: E402
+
+SLOTS, NEW, PLEN = 8, 64, 32
+
+
+def run(moe, fast):
+    os.environ["DS_TPU_MOE_FAST"] = "1" if fast else "0"
+    mesh_mod.set_mesh(None)
+    cfg = gpt2_config("gpt2-125m", moe=moe, scan_layers=True)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       max_tokens=128)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
+               for _ in range(SLOTS)]
+    b = ContinuousBatcher(eng, n_slots=SLOTS)
+    b.run(prompts, max_new_tokens=4, ticks=16)
+    # decode-only: occupy slots, time steady windows
+    for p in prompts:
+        b.submit(p, max_new_tokens=NEW)
+    b.step(ticks=1)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        b.step(ticks=16)
+    dt = time.perf_counter() - t0
+    tok = SLOTS * 48 / dt
+    # e2e like the bench
+    t0 = time.perf_counter()
+    outs = b.run(prompts, max_new_tokens=NEW, ticks=16)
+    e2e = sum(len(o) - PLEN for o in outs) / (time.perf_counter() - t0)
+    del b, eng
+    return tok, e2e
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "moe"):
+        moe = MoEConfig(num_experts=8, top_k=1)
+        for fast in (True, False):
+            tok, e2e = run(moe, fast)
+            print(f"fast={fast}: decode-only {tok:.1f} tok/s, e2e {e2e:.1f}",
+                  flush=True)
+    if which in ("all", "dense"):
+        tok, e2e = run(None, False)
+        print(f"dense: decode-only {tok:.1f} tok/s, e2e {e2e:.1f}",
+              flush=True)

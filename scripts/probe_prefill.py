@@ -1,0 +1,76 @@
+"""Round-5: where does the int8 prefill batch spend its time?
+
+Times each stage of _prefill_batch separately (blocking between stages,
+10 reps each): prompt upload, compiled prefill (8,32), first-token
+sampler, device_get.  Run: python scripts/probe_prefill.py [fp|int8]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.inference.serving import ContinuousBatcher  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+PRESET, SLOTS, PLEN = "gpt2-760m", 8, 32
+
+
+def main(quant):
+    cfg = gpt2_config(PRESET)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       quant=quant, max_tokens=128)
+    rng = np.random.default_rng(0)
+    b = ContinuousBatcher(eng, n_slots=SLOTS)
+    prompts = np.stack([rng.integers(0, cfg.vocab_size, size=(PLEN,))
+                        .astype(np.int32) for _ in range(SLOTS)])
+    # warm everything once
+    logits, cacheB = b._prefill(jnp.asarray(prompts))
+    seen = np.zeros((SLOTS, 1, b._vocab), bool)
+    fB, s1B = b._first_token_batch(
+        logits[:, -1:, :], jnp.asarray(seen),
+        jnp.arange(SLOTS, dtype=jnp.int32),
+        jnp.zeros(SLOTS, jnp.float32), jnp.ones(SLOTS, jnp.float32),
+        jnp.ones(SLOTS, jnp.float32))
+    jax.block_until_ready((fB, s1B))
+
+    N = 10
+    t0 = time.perf_counter()
+    for _ in range(N):
+        ids = jnp.asarray(prompts)
+        jax.block_until_ready(ids)
+    print(f"upload:   {(time.perf_counter()-t0)/N*1e3:8.1f} ms", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(N):
+        logits, cacheB = b._prefill(ids)
+        jax.block_until_ready(logits)
+    print(f"prefill:  {(time.perf_counter()-t0)/N*1e3:8.1f} ms", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(N):
+        sj = jnp.asarray(seen)
+        fB, s1B = b._first_token_batch(
+            logits[:, -1:, :], sj, jnp.arange(SLOTS, dtype=jnp.int32),
+            jnp.zeros(SLOTS, jnp.float32), jnp.ones(SLOTS, jnp.float32),
+            jnp.ones(SLOTS, jnp.float32))
+        jax.block_until_ready(fB)
+    print(f"sample:   {(time.perf_counter()-t0)/N*1e3:8.1f} ms", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(N):
+        np.asarray(jax.device_get(fB))
+    print(f"get:      {(time.perf_counter()-t0)/N*1e3:8.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "int8"
+    main({} if which == "fp" else {"enabled": True, "bits": 8})

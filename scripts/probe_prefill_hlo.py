@@ -1,0 +1,52 @@
+"""Inspect the compiled (8,32) prefill executable: temp-buffer sizes and
+dominant HLO ops, fp vs int8.  Run: python scripts/probe_prefill_hlo.py"""
+import re
+import sys
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+PRESET, SLOTS, PLEN = "gpt2-760m", 8, 32
+
+
+def main(quant, tag):
+    cfg = gpt2_config(PRESET)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       quant=quant, max_tokens=128)
+    cache = eng.init_cache(SLOTS)
+    ids = jnp.zeros((SLOTS, PLEN), jnp.int32)
+    pos = jnp.arange(PLEN)[None, :]
+    lowered = jax.jit(
+        lambda p, c, i, q: eng._compiled_prefill.__wrapped__(p, c, i, q)
+        if hasattr(eng._compiled_prefill, "__wrapped__")
+        else eng._compiled_prefill(p, c, i, q))
+    comp = eng._compiled_prefill.lower(eng.params, cache, ids, pos).compile()
+    ma = comp.memory_analysis()
+    print(f"== {tag}: temp={ma.temp_size_in_bytes/1e6:.1f}MB "
+          f"arg={ma.argument_size_in_bytes/1e6:.1f}MB "
+          f"out={ma.output_size_in_bytes/1e6:.1f}MB", flush=True)
+    txt = comp.as_text()
+    ops = Counter(re.findall(r"= (\w+)\(", txt))
+    print("top ops:", ops.most_common(12), flush=True)
+    # biggest-shaped convert/multiply (dequant fingerprints)
+    for kind in ("convert", "multiply", "dot", "custom-call"):
+        shapes = Counter(re.findall(rf"(\S+) {kind}\(", txt))
+        big = sorted(shapes, key=lambda s: -len(s))[:3]
+        print(f"{kind}: {big}", flush=True)
+    del eng
+
+
+if __name__ == "__main__":
+    main({"enabled": True, "bits": 8}, "int8")
+    main({}, "fp")

@@ -1,0 +1,129 @@
+"""Round-5 diagnostic: where do serving TTFT ms and int8 decode tok/s go?
+
+Phases timed on the real chip (one run per variant):
+  1. per-phase timeline of the first step() after 16 submits (prefill
+     dispatch, first-token sample+get per batch, placement, first window)
+  2. decode-only throughput over a long window (no admission churn)
+  3. HLO check: does the compiled multi_step contain the Pallas W8A16
+     custom call in the int8 variant?
+Run: python scripts/probe_serving.py [fp|int8|both]
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.inference.serving import ContinuousBatcher  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+PRESET, SLOTS, NEW, PLEN = "gpt2-760m", 8, 64, 32
+
+
+def build(quant):
+    cfg = gpt2_config(PRESET)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params, quant=quant,
+                                      max_tokens=128)
+    return cfg, eng
+
+
+def probe(tag, quant):
+    print(f"=== {tag} ===", flush=True)
+    t0 = time.perf_counter()
+    cfg, eng = build(quant)
+    print(f"build+quantize: {time.perf_counter()-t0:.2f}s", flush=True)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
+               for _ in range(SLOTS * 2)]
+    b = ContinuousBatcher(eng, n_slots=SLOTS)
+    t0 = time.perf_counter()
+    b.run(prompts[:SLOTS], max_new_tokens=4, ticks=64)
+    print(f"warmup run: {time.perf_counter()-t0:.2f}s", flush=True)
+    t0 = time.perf_counter()
+    b.warmup_windows(64)
+    print(f"warmup_windows: {time.perf_counter()-t0:.2f}s", flush=True)
+
+    # HLO check on the 16-tick window executable
+    txt = b._multi_step(16, True).lower(
+        eng.params, b._cache, b._token, b._pos, jnp.arange(SLOTS), b._temp,
+        b._top_p, b._rep, b._seen, b._done, jnp.int32(0), jnp.int32(-1),
+        jnp.int32(0)).compile().as_text()
+    n_cc = txt.count("custom-call")
+    n_pallas = txt.count("tpu_custom_call")
+    print(f"decode HLO: custom-calls={n_cc} tpu_custom_call={n_pallas}",
+          flush=True)
+
+    # phase timeline of the timed run's first step
+    b.reset_latency_stats()
+    t_sub = time.perf_counter()
+    for p in prompts:
+        b.submit(p, max_new_tokens=NEW)
+    print(f"submit x16: {time.perf_counter()-t_sub:+.3f}s", flush=True)
+
+    import deepspeed_tpu.inference.serving as srv
+    orig_pb = ContinuousBatcher._prefill_batch
+    orig_admit = ContinuousBatcher._admit
+
+    def timed_pb(self, n):
+        t = time.perf_counter()
+        orig_pb(self, n)
+        print(f"  _prefill_batch({n}): {time.perf_counter()-t:.3f}s "
+              f"@+{time.perf_counter()-t_sub:.3f}s", flush=True)
+
+    def timed_admit(self):
+        t = time.perf_counter()
+        orig_admit(self)
+        print(f"  _admit: {time.perf_counter()-t:.3f}s", flush=True)
+
+    ContinuousBatcher._prefill_batch = timed_pb
+    ContinuousBatcher._admit = timed_admit
+    t0 = time.perf_counter()
+    b.step(ticks=64)
+    print(f"first step(64): {time.perf_counter()-t0:.3f}s", flush=True)
+    ContinuousBatcher._prefill_batch = orig_pb
+    ContinuousBatcher._admit = orig_admit
+    t0 = time.perf_counter()
+    done = sum(len(v) - PLEN for v in b._finished.values())
+    while b.pending:
+        b.step(ticks=64)
+    dt = time.perf_counter() - t0
+    toks = sum(len(v) - PLEN for v in b._finished.values()) - done
+    lat = b.latency_stats()
+    print(json.dumps({
+        "tag": tag, "decode_tok_s_after_first": round(toks / dt, 1),
+        "ttft_p50_ms": round(1000 * lat["ttft_p50_s"], 1),
+        "ttft_p90_ms": round(1000 * lat["ttft_p90_s"], 1)}), flush=True)
+
+    # decode-only throughput: fill slots, run 4x16 ticks, time the windows
+    prompts2 = [rng.integers(0, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
+                for _ in range(SLOTS)]
+    for p in prompts2:
+        b.submit(p, max_new_tokens=NEW)
+    b.step(ticks=1)   # admit + 1 tick
+    t0 = time.perf_counter()
+    for _ in range(3):
+        b.step(ticks=64)
+    dt = time.perf_counter() - t0
+    print(f"decode-only: {SLOTS*48/dt:.1f} tok/s "
+          f"({dt/48*1000:.2f} ms/tick)", flush=True)
+    while b.pending:
+        b.step(ticks=64)
+    del b, eng
+    return None
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("fp", "both"):
+        probe("fp", {})
+    if which in ("int8", "both"):
+        probe("int8", {"enabled": True, "bits": 8})

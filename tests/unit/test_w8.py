@@ -69,8 +69,10 @@ def test_init_inference_int8_real_storage():
     assert q_leaves and all(l.dtype == jnp.int8 for _, l in q_leaves)
     assert not any(jax.tree_util.keystr(p).endswith("_kernel']")
                    for p, _ in leaves)
-    # kernel storage: int8 codes + scales ≤ ~30% of the fp32 kernels
-    # (embeddings/norms stay full width and dominate at tiny scale)
+    # kernel storage: int8 codes + scales ≤ ~60% of the fp kernels (the
+    # fp engine itself now stores bf16 at load, so the bound is vs bf16;
+    # codes are exactly half of bf16, scales add a sliver — at this tiny
+    # size the group falls back to g=K so scales are one fp32 row)
     q8_kernel_bytes = sum(
         l.nbytes for p, l in leaves
         if "_kernel_q']" in jax.tree_util.keystr(p)
@@ -79,7 +81,7 @@ def test_init_inference_int8_real_storage():
         l.nbytes for p, l in
         jax.tree_util.tree_leaves_with_path(eng_fp.params)
         if jax.tree_util.keystr(p).endswith("_kernel']"))
-    assert q8_kernel_bytes < 0.3 * fp_kernel_bytes
+    assert q8_kernel_bytes < 0.6 * fp_kernel_bytes
 
     # compute stays faithful: greedy decode agrees with full precision
     ids = np.asarray(
