@@ -54,25 +54,68 @@ def logical_to_mesh_axes(logical_names: tuple, rules: dict) -> P:
 def resolve_remat_policy(name: str):
     """Config remat-policy name → ``jax.checkpoint_policies`` callable.
 
-    Beyond the stock names, ``"<base>+flash"`` combines the base policy
-    with saving the flash-attention kernel's named residuals
-    (``flash_out`` / ``flash_lse``): pallas outputs are not dot outputs,
-    so every dot-based policy discards them and remat re-runs the whole
-    forward kernel inside each backward — "+flash" trades that recompute
-    for O(B·S·E) bf16 of saved activations per layer."""
-    base, plus, extra = name.partition("+")
+    Beyond the stock names:
+
+    - ``"<base>+flash"`` combines the base policy with saving the
+      flash-attention kernel's named residuals (``flash_out`` /
+      ``flash_lse``): pallas outputs are not dot outputs, so every
+      dot-based policy discards them and remat re-runs the whole forward
+      kernel inside each backward — "+flash" trades that recompute for
+      O(B·S·E) bf16 of saved activations per layer.
+    - ``"<base>+offload"`` is the reference's ``cpu_checkpointing``
+      (``activation_checkpointing/checkpointing.py:367-460``): saved
+      residuals move to pinned host memory and are fetched back during
+      backward — HBM cost becomes O(1) activations at the price of
+      PCIe/DMA traffic.  jax ships only the no-batch-dims offload
+      policy, so for ``dots_saveable``/``checkpoint_dots`` bases the
+      batch-dims dots fall back to RECOMPUTE under ``+offload`` (warned
+      once); the exact pairings are the ``*_no_batch_dims*`` bases and
+      "+flash" named residuals.  Non-dot bases raise (loudly, not as a
+      silent no-op)."""
+    parts = name.split("+")
+    base, extras = parts[0], parts[1:]
+    bad = [e for e in extras if e not in ("flash", "offload")]
+    if bad:
+        raise ValueError(f"unknown remat policy suffix {bad[0]!r} in "
+                         f"{name!r} (supported: '+flash', '+offload')")
+    offload = "offload" in extras
     cp = jax.checkpoint_policies
     pol = getattr(cp, base, None)
     if pol is None:
         raise ValueError(f"unknown remat policy {base!r}; see "
                          "jax.checkpoint_policies")
-    if plus:
-        if extra != "flash":
-            raise ValueError(f"unknown remat policy suffix {extra!r} in "
-                             f"{name!r} (supported: '+flash')")
-        pol = cp.save_from_both_policies(
-            pol, cp.save_only_these_names("flash_out", "flash_lse"))
+    if offload:
+        dot_bases = {"dots_saveable", "checkpoint_dots",
+                     "dots_with_no_batch_dims_saveable",
+                     "checkpoint_dots_with_no_batch_dims"}
+        if base in dot_bases:
+            if base in ("dots_saveable", "checkpoint_dots"):
+                from ..utils.logging import logger
+
+                logger.warning(
+                    f"remat policy {base!r}+offload: jax only offers a "
+                    "no-batch-dims offload policy, so dots WITH batch "
+                    "dims are recomputed (not saved in HBM, not "
+                    "offloaded); use 'dots_with_no_batch_dims_saveable"
+                    "+offload' to silence this")
+            pol = cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+        else:
+            raise NotImplementedError(
+                f"cpu_checkpointing (+offload) is not defined for remat "
+                f"policy {base!r}; use a dot-based policy")
+    if "flash" in extras:
+        if offload:
+            flash_pol = cp.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=list(_FLASH_RESIDUALS),
+                offload_src="device", offload_dst="pinned_host")
+        else:
+            flash_pol = cp.save_only_these_names(*_FLASH_RESIDUALS)
+        pol = cp.save_from_both_policies(pol, flash_pol)
     return pol
+
+
+_FLASH_RESIDUALS = ("flash_out", "flash_lse")
 
 
 def param_with_axes(init_fn, names: tuple):

@@ -30,11 +30,17 @@ PEAK_TFLOPS = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
                "v6 lite": 918e12, "v6e": 918e12}
 
 
-def profile_compiled(fn: Callable, *args, static_argnums=()) -> dict:
-    """Exact cost analysis of the compiled program for ``fn(*args)``."""
+def profile_compiled(fn: Callable, *args, static_argnums=(),
+                     lowered=None) -> dict:
+    """Exact cost analysis of the compiled program for ``fn(*args)``.
+
+    Pass ``lowered`` (a ``jax.stages.Lowered``) to reuse an existing
+    lowering — tracing a 1.5B multi-step program twice is minutes."""
     import jax
 
-    compiled = jax.jit(fn, static_argnums=static_argnums).lower(*args).compile()
+    if lowered is None:
+        lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
+    compiled = lowered.compile()
     costs = compiled.cost_analysis()
     if isinstance(costs, list):  # some backends return [dict]
         costs = costs[0] if costs else {}
@@ -51,6 +57,70 @@ def profile_compiled(fn: Callable, *args, static_argnums=()) -> dict:
             + getattr(mem, "argument_size_in_bytes", 0)
             + getattr(mem, "output_size_in_bytes", 0))
     return out
+
+
+def module_flops_breakdown(fn: Callable, *args, depth: int = 3,
+                           static_argnums=(), lowered=None) -> dict:
+    """Per-module matmul-FLOPs attribution (the reference's per-module
+    MACs tree, ``profiler.py:477-700``, rebuilt from compiler metadata).
+
+    Parses the lowered StableHLO: every ``dot_general`` carries its
+    operand/result types inline and a ``loc(...)`` breadcrumb holding the
+    flax module path (named scopes), so math-level FLOPs can be summed
+    per module WITHOUT monkey-patching entry points.  Layer indices are
+    collapsed (``h_0`` → ``h``) so unrolled stacks aggregate like
+    scanned ones.  Returns {module_path: flops}, most expensive first.
+    """
+    import jax
+
+    if lowered is None:
+        lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
+    txt = lowered.as_text(debug_info=True)
+    # location table: #locN = loc("path"...) possibly chained
+    import re
+
+    loc_table = {}
+    for m in re.finditer(r'(#loc\d+) = loc\("([^"]*)"', txt):
+        loc_table[m.group(1)] = m.group(2)
+
+    def resolve(loc_ref: str) -> str:
+        if loc_ref.startswith("#loc"):
+            return loc_table.get(loc_ref, "")
+        return loc_ref
+
+    def group(path: str) -> str:
+        path = re.sub(r"^jit\([^)]*\)/", "", path)
+        segs = [s for s in path.split("/")
+                if s and not s.startswith(("jvp(", "transpose(", "remat",
+                                           "checkpoint", "while", "body",
+                                           "cond", "broadcast_in_dim"))]
+        segs = [re.sub(r"_\d+$", "", s) for s in segs]
+        segs = [s for s in segs if s not in ("dot_general", "transpose")]
+        return "/".join(segs[:depth]) or "<top>"
+
+    cd_re = re.compile(r"contracting_dims\s*=\s*\[([\d, ]*)\]")
+    ty_re = re.compile(r":\s*\(tensor<([^>]+)>,\s*tensor<[^>]+>\)"
+                       r"\s*->\s*tensor<([^>]+)>")
+    loc_re = re.compile(r'loc\((#loc\d+|"[^"]*")')
+    out: dict = {}
+    for line in txt.splitlines():
+        if "stablehlo.dot_general" not in line:
+            continue
+        cd, ty, lc = cd_re.search(line), ty_re.search(line), \
+            loc_re.search(line)
+        if not (cd and ty and lc):
+            continue
+        try:
+            lhs_cd = [int(x) for x in cd.group(1).split(",") if x.strip()]
+            lhs = [int(x) for x in ty.group(1).split("x")[:-1]]
+            res = [int(x) for x in ty.group(2).split("x")[:-1]]
+        except ValueError:      # dynamic dims — skip the op
+            continue
+        k = int(np.prod([lhs[d] for d in lhs_cd])) if lhs_cd else 1
+        flops = 2.0 * float(np.prod(res)) * k if res else 2.0 * k
+        path = group(resolve(lc.group(1).strip('"')))
+        out[path] = out.get(path, 0.0) + flops
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
 
 def params_profile(params) -> dict:
@@ -94,6 +164,7 @@ class FlopsProfiler:
         self.engine = engine
         self.program_costs: dict = {}
         self.param_costs: dict = {}
+        self.module_flops: dict = {}
         self.step_times: list[float] = []
         self._started = False
         self._t0 = 0.0
@@ -106,9 +177,22 @@ class FlopsProfiler:
                     batch_size=eng.train_batch_size,
                     seq_len=getattr(eng.model.cfg, "n_positions", None))
             if batch is not None:
+                import jax
+
                 batch = eng._shard_batch(batch)
-                self.program_costs = profile_compiled(
-                    lambda s, b: eng._compiled_train_step(s, b), eng.state, batch)
+                # lower ONCE; cost analysis and the per-module breakdown
+                # both derive from the same Lowered (re-tracing a large
+                # multi-step program costs minutes)
+                lowered = jax.jit(
+                    lambda s, b: eng._compiled_train_step(s, b)).lower(
+                    eng.state, batch)
+                self.program_costs = profile_compiled(None, lowered=lowered)
+                try:
+                    self.module_flops = module_flops_breakdown(
+                        None, lowered=lowered)
+                except Exception as e:   # text-format drift must not
+                    logger.warning(      # break profiling itself
+                        f"per-module breakdown unavailable: {e!r}")
             self.param_costs = params_profile(eng.params)
         self._started = True
         self._t0 = time.perf_counter()
@@ -129,6 +213,8 @@ class FlopsProfiler:
     def summary(self) -> dict:
         out = dict(self.program_costs)
         out.update(self.param_costs)
+        if self.module_flops:
+            out["module_flops"] = dict(self.module_flops)
         if self.step_times:
             mean_t = float(np.mean(self.step_times))
             out["mean_step_ms"] = 1000 * mean_t
@@ -151,6 +237,20 @@ class FlopsProfiler:
         logger.info(f"  params ................... {s.get('total_params', 0)/1e6:.1f}M")
         for name, n in sorted(s.get("per_module", {}).items()):
             logger.info(f"    {name:<20} {n/1e6:.2f}M")
+        if self.module_flops:
+            # per-module matmul flops (math-level, pre-fusion) + the step
+            # time attributed by flops share — the reference's per-module
+            # latency tree analog (profiler.py:477-700); ESTIMATED ms, a
+            # flops-proportional split of the measured step
+            total = sum(self.module_flops.values()) or 1.0
+            mean_ms = (1000 * float(np.mean(self.step_times))
+                       if self.step_times else None)
+            logger.info("  per-module matmul flops (share | est. ms):")
+            for name, fl in self.module_flops.items():
+                share = fl / total
+                est = f" | ~{share*mean_ms:7.1f} ms" if mean_ms else ""
+                logger.info(f"    {name:<32} {fl:.3e} ({100*share:5.1f}%)"
+                            f"{est}")
         if "mean_step_ms" in s:
             logger.info(f"  mean step time ........... {s['mean_step_ms']:.1f} ms")
         if "mfu" in s:
@@ -174,4 +274,8 @@ def get_model_profile(model, batch, loss_fn=None) -> dict:
         params, is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
     costs = profile_compiled(fwd, params, batch)
     costs.update(params_profile(params))
+    try:
+        costs["module_flops"] = module_flops_breakdown(fwd, params, batch)
+    except Exception:    # never let text-format drift break profiling
+        pass
     return costs

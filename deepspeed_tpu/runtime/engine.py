@@ -73,12 +73,42 @@ class Engine:
                 model.cfg, **self.config.model_overrides))
             self.model = model
         ac = self.config.activation_checkpointing
-        if (ac.enabled and hasattr(model, "cfg")
-                and hasattr(model.cfg, "remat") and not model.cfg.remat):
-            # config-driven remat (reference checkpointing.py:825 configure):
-            # zoo models carry the jax.checkpoint policy on their layer stack
-            self.model = type(model)(dataclasses.replace(
-                model.cfg, remat=True, remat_policy=ac.policy))
+        if ac.enabled and hasattr(model, "cfg") \
+                and hasattr(model.cfg, "remat"):
+            # config-driven remat (reference checkpointing.py:825
+            # configure): zoo models carry the jax.checkpoint policy on
+            # their layer stack — a model that already has remat on keeps
+            # its own policy.  cpu_checkpointing (reference
+            # checkpointing.py:367) switches to the host-offload policy
+            # variant; a non-offloadable base (e.g. the default
+            # 'nothing_saveable') upgrades to the no-batch-dims dot
+            # policy so the plain reference-style config runs, and the
+            # policy resolves EAGERLY here so a bad combination fails at
+            # engine build, not deep inside the first forward trace.
+            policy = model.cfg.remat_policy if model.cfg.remat \
+                else ac.policy
+            if ac.cpu_checkpointing and "+offload" not in policy:
+                if policy.split("+")[0] in ("nothing_saveable",
+                                            "everything_saveable"):
+                    policy = "dots_with_no_batch_dims_saveable" + \
+                        "".join("+" + p for p in policy.split("+")[1:])
+                    log_dist(
+                        f"cpu_checkpointing: upgrading remat policy to "
+                        f"{policy!r}+offload (the configured base saves "
+                        "nothing offloadable)", ranks=[0])
+                policy += "+offload"
+            if (not model.cfg.remat) or policy != model.cfg.remat_policy:
+                from ..models.common import resolve_remat_policy
+
+                resolve_remat_policy(policy)   # fail fast on bad combos
+                self.model = type(model)(dataclasses.replace(
+                    model.cfg, remat=True, remat_policy=policy))
+        elif ac.enabled and ac.cpu_checkpointing:
+            raise NotImplementedError(
+                "cpu_checkpointing requires a zoo model with config-driven "
+                "remat (model.cfg.remat); for custom modules apply "
+                "deepspeed_tpu.checkpointing.checkpoint with an '+offload' "
+                "policy directly")
         self.client_optimizer = optimizer
         self._partition_rules = dict(TP_RULES if partition_rules is None else partition_rules)
 

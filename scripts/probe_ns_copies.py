@@ -1,0 +1,63 @@
+"""Compile (AOT, no run) the 1.5B multi-step program and measure how many
+bytes of `copy` ops the while-loop body carries — loop-carried state that
+XLA fails to alias in place is pure wasted HBM bandwidth every step.
+Run: python scripts/probe_ns_copies.py [steps]
+"""
+import re
+import sys
+from collections import Counter
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+SEQ = 1024
+_SIZES = {"f32": 4, "bf16": 2, "f16": 2, "u8": 1, "s8": 1, "s32": 4,
+          "u32": 4, "pred": 1}
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    on_tpu = jax.devices()[0].platform == "tpu"
+    preset = "gpt2-1.5b" if on_tpu else "gpt2-tiny"
+    seq = SEQ if on_tpu else 128
+    cfg = gpt2_config(preset, n_positions=seq, scan_layers=not on_tpu,
+                      remat=True, remat_policy="dots_saveable+flash"
+                      if on_tpu else "dots_saveable",
+                      loss_chunk=8192 if on_tpu else None)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), config={
+            "train_micro_batch_size_per_gpu": 2 if on_tpu else 1,
+            "optimizer": {"type": "adamw8bit",
+                          "params": {"lr": 1e-4, "weight_decay": 0.1}},
+            "zero_optimization": {"stage": 3},
+            "steps_per_print": 10**6})
+    engine.init_params()
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size, seq)).astype(np.int32)
+    batch = engine.prepare_batch({"input_ids": ids, "labels": ids})
+    fn = engine._compiled_multi_step(steps, False)
+    comp = fn.lower(engine._state, batch, None).compile()
+    txt = comp.as_text()
+    total = 0
+    by_shape: Counter = Counter()
+    for m in re.finditer(r"= (\w+)\[([\d,]*)\][^=]*? copy\(", txt):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _SIZES:
+            continue
+        n = int(np.prod([int(d) for d in dims.split(",") if d])) if dims \
+            else 1
+        total += n * _SIZES[dt]
+        by_shape[f"{dt}[{dims}]"] += 1
+    print(f"copy ops total bytes (static, whole program): "
+          f"{total/2**30:.3f} GiB", flush=True)
+    for shape, cnt in by_shape.most_common(10):
+        print(f"  {cnt:4d} x {shape}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

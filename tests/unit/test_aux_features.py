@@ -232,6 +232,14 @@ def test_flops_profiler_engine():
     assert s["total_params"] > 0
     assert s["flops"] > 0
     assert s["mean_step_ms"] > 0
+    # per-module attribution (reference profiler.py:477-700 analog):
+    # the attention-vs-mlp split must be visible and account for the
+    # bulk of the model's matmul flops
+    mf = s["module_flops"]
+    attn = sum(v for k, v in mf.items() if "attn" in k)
+    mlp = sum(v for k, v in mf.items() if "mlp" in k)
+    assert attn > 0 and mlp > 0
+    assert mlp > attn   # 4x-wide FFN out-flops attention at seq 32
     prof.print_profile()
 
 
