@@ -90,9 +90,9 @@ def resolve_remat_policy(name: str):
                      "checkpoint_dots_with_no_batch_dims"}
         if base in dot_bases:
             if base in ("dots_saveable", "checkpoint_dots"):
-                from ..utils.logging import logger
+                from ..utils.logging import warning_once
 
-                logger.warning(
+                warning_once(
                     f"remat policy {base!r}+offload: jax only offers a "
                     "no-batch-dims offload policy, so dots WITH batch "
                     "dims are recomputed (not saved in HBM, not "
@@ -116,6 +116,21 @@ def resolve_remat_policy(name: str):
 
 
 _FLASH_RESIDUALS = ("flash_out", "flash_lse")
+
+
+def offloadable_policy_name(name: str) -> str:
+    """Policy name with cpu_checkpointing applied: append ``+offload``,
+    upgrading a base that saves nothing offloadable to the no-batch-dims
+    dot policy first (so the plain reference-style
+    ``{"cpu_checkpointing": true}`` config runs).  Shared by the engine
+    config path and the functional ``checkpoint()`` API."""
+    if "+offload" in name:
+        return name
+    parts = name.split("+")
+    if parts[0] in ("nothing_saveable", "everything_saveable"):
+        name = "dots_with_no_batch_dims_saveable" + \
+            "".join("+" + p for p in parts[1:])
+    return name + "+offload"
 
 
 def param_with_axes(init_fn, names: tuple):

@@ -57,17 +57,12 @@ def _policy():
     from ..models.common import resolve_remat_policy
 
     name = _config.policy if _config.enabled else "nothing_saveable"
-    if _config.enabled and _config.cpu_checkpointing \
-            and "+offload" not in name:
+    if _config.enabled and _config.cpu_checkpointing:
         # reference checkpoint_in_cpu (checkpointing.py:367): saved
-        # residuals live in pinned host memory, not HBM.  A base that
-        # saves nothing offloadable upgrades to the dot policy so the
-        # plain {"cpu_checkpointing": true} config works.
-        if name.split("+")[0] in ("nothing_saveable",
-                                  "everything_saveable"):
-            name = "dots_with_no_batch_dims_saveable" + \
-                "".join("+" + p for p in name.split("+")[1:])
-        name += "+offload"
+        # residuals live in pinned host memory, not HBM
+        from ..models.common import offloadable_policy_name
+
+        name = offloadable_policy_name(name)
     return resolve_remat_policy(name)
 
 

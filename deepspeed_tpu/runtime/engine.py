@@ -87,16 +87,17 @@ class Engine:
             # engine build, not deep inside the first forward trace.
             policy = model.cfg.remat_policy if model.cfg.remat \
                 else ac.policy
-            if ac.cpu_checkpointing and "+offload" not in policy:
-                if policy.split("+")[0] in ("nothing_saveable",
-                                            "everything_saveable"):
-                    policy = "dots_with_no_batch_dims_saveable" + \
-                        "".join("+" + p for p in policy.split("+")[1:])
+            if ac.cpu_checkpointing:
+                from ..models.common import offloadable_policy_name
+
+                upgraded = offloadable_policy_name(policy)
+                if upgraded != policy + "+offload" and \
+                        "+offload" not in policy:
                     log_dist(
-                        f"cpu_checkpointing: upgrading remat policy to "
-                        f"{policy!r}+offload (the configured base saves "
-                        "nothing offloadable)", ranks=[0])
-                policy += "+offload"
+                        f"cpu_checkpointing: upgrading remat policy "
+                        f"{policy!r} to {upgraded!r} (the configured "
+                        "base saves nothing offloadable)", ranks=[0])
+                policy = upgraded
             if (not model.cfg.remat) or policy != model.cfg.remat_policy:
                 from ..models.common import resolve_remat_policy
 

@@ -1,0 +1,69 @@
+"""Split the int8-vs-fp admission gap: time dispatch vs sync stages
+inside _prefill_batch on the bench geometry.
+Run: python scripts/probe_admission.py [fp|int8]"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.comm import mesh as mesh_mod  # noqa: E402
+from deepspeed_tpu.inference import serving as srv  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+SLOTS, PLEN = 8, 32
+
+
+def main(quant, tag):
+    mesh_mod.set_mesh(None)
+    cfg = gpt2_config("gpt2-760m")
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       quant=quant, max_tokens=160)
+    rng = np.random.default_rng(0)
+    b = srv.ContinuousBatcher(eng, n_slots=SLOTS)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
+               for _ in range(SLOTS)]
+    b.run(prompts, max_new_tokens=4, ticks=64)     # warm
+
+    for it in range(4):
+        reqs = [srv.Request(1000 + it * 10 + i, p, 32)
+                for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
+        t1 = time.perf_counter()
+        logits, cacheB = b._prefill(ids)
+        t2 = time.perf_counter()
+        seen = np.zeros((SLOTS, 1, b._vocab), bool)
+        for row, r in enumerate(reqs):
+            seen[row, 0, r.prompt] = True
+        t3 = time.perf_counter()
+        fB, s1B = b._first_token_batch(
+            logits[:, -1:, :], jnp.asarray(seen),
+            jnp.asarray([r.uid for r in reqs], jnp.int32),
+            jnp.zeros(SLOTS, jnp.float32), jnp.ones(SLOTS, jnp.float32),
+            jnp.ones(SLOTS, jnp.float32))
+        t4 = time.perf_counter()
+        np.asarray(jax.device_get(fB))
+        t5 = time.perf_counter()
+        print(f"{tag} it{it}: upload={1e3*(t1-t0):6.1f} "
+              f"prefill_dispatch={1e3*(t2-t1):6.1f} "
+              f"seen_host={1e3*(t3-t2):6.1f} "
+              f"sample_dispatch={1e3*(t4-t3):6.1f} "
+              f"get_sync={1e3*(t5-t4):6.1f} ms", flush=True)
+    del eng, b
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("fp", "both"):
+        main({}, "fp")
+    if which in ("int8", "both"):
+        main({"enabled": True, "bits": 8}, "int8")

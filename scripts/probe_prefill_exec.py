@@ -1,0 +1,51 @@
+"""Precise prefill-executable device time: N pipelined calls, ONE fence.
+Run: python scripts/probe_prefill_exec.py [fp|int8]"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.comm import mesh as mesh_mod  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+
+SLOTS, PLEN = 8, 32
+
+
+def main(quant, tag):
+    mesh_mod.set_mesh(None)
+    cfg = gpt2_config("gpt2-760m")
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       quant=quant, max_tokens=160)
+    cache = eng.init_cache(SLOTS)
+    ids = jnp.zeros((SLOTS, PLEN), jnp.int32)
+    pos = jnp.arange(PLEN)[None, :]
+    logits, c2 = eng._compiled_prefill(eng.params, cache, ids, pos)
+    jax.device_get(logits[0, 0, 0])          # warm + fence
+    for N in (10, 50):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(N):
+            logits, _ = eng._compiled_prefill(eng.params, cache, ids, pos)
+            out = logits
+        jax.device_get(out[0, 0, 0])
+        dt = time.perf_counter() - t0
+        print(f"{tag}: N={N}  {dt/N*1e3:7.2f} ms/prefill "
+              f"(total {dt:.2f}s)", flush=True)
+    del eng
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("fp", "both"):
+        main({}, "fp")
+    if which in ("int8", "both"):
+        main({"enabled": True, "bits": 8}, "int8")

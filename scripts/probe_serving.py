@@ -21,7 +21,7 @@ import deepspeed_tpu  # noqa: E402
 from deepspeed_tpu.inference.serving import ContinuousBatcher  # noqa: E402
 from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
 
-PRESET, SLOTS, NEW, PLEN = "gpt2-760m", 8, 64, 32
+PRESET, SLOTS, NEW, PLEN = "gpt2-760m", 8, 128, 32
 
 
 def build(quant):
@@ -32,7 +32,7 @@ def build(quant):
         model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"],
         is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
     eng = deepspeed_tpu.init_inference(model=model, params=params, quant=quant,
-                                      max_tokens=128)
+                                      max_tokens=160)
     return cfg, eng
 
 

@@ -68,3 +68,34 @@ def test_llama_generate_matches_forward():
     assert out.shape == (1, 10)
     full = np.asarray(eng(out[:, :-1]), np.float32)
     assert int(out[0, -1]) == int(full.argmax(-1)[0, -1])
+
+
+def test_llama_continuous_batcher_fp_and_int8():
+    """The bench's llama GQA serving path: continuous batching over the
+    grouped-query decode cache, fp and W8A16, with cache_len sized to
+    the generation budget (max_tokens)."""
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+
+    rng = np.random.default_rng(0)
+    for quant in ({}, {"enabled": True, "bits": 8}):
+        mesh_mod.set_mesh(None)
+        cfg = llama_config("llama-tiny")
+        model = LlamaForCausalLM(cfg)
+        params = jax.tree_util.tree_map(
+            lambda x: getattr(x, "value", x),
+            model.init(jax.random.PRNGKey(0),
+                       np.zeros((1, 8), np.int32))["params"],
+            is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                           quant=quant, max_tokens=32)
+        # rotary family: max_tokens resizes the cache itself
+        assert eng._gen_limit == 32
+        cache_lens = {l.shape[-3] for p, l in
+                      jax.tree_util.tree_leaves_with_path(eng.init_cache(1))
+                      if "cached_key" in jax.tree_util.keystr(p)}
+        assert cache_lens == {32}, cache_lens
+        b = ContinuousBatcher(eng, n_slots=2)
+        prompts = [rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+                   for _ in range(4)]
+        outs = b.run(prompts, max_new_tokens=9, ticks=4)
+        assert all(len(o) == 16 for o in outs), [len(o) for o in outs]
