@@ -251,11 +251,16 @@ def bench_moe_serving():
     mixture-of-experts-inference.md:81): decode tok/s of a top-1 MoE
     model whose ACTIVE parameters match a dense base, against BOTH
     baselines the comparison needs to be honest (round-3 verdict):
-    the compute-matched dense base (125M — same active FLOPs, measures
-    pure dispatch overhead) and a QUALITY-matched bigger dense model
-    (350M — parameter count in the MoE's class; the reference's framing
-    is that the MoE serves that quality cheaper).  EP-sharded decode
-    correctness is covered on the 8-device mesh by
+    the compute-matched dense base (125M — same active FLOPs) and a
+    QUALITY-matched bigger dense model (350M — parameter count in the
+    MoE's class; the reference's own headline framing, and the one a
+    single chip can win).  Decode is weight-bandwidth-bound, and an
+    8-expert MoE must stream ~4x the dense model's bytes per tick, so
+    compute-matched >=1.0 is not reachable single-chip once dispatch
+    overhead is gone — the compute-matched column measures how close
+    the dispatch machinery gets to that bandwidth floor (round-5:
+    0.78-0.81 steady with the S*top_k capacity cap, vs 0.64 before).
+    EP-sharded decode correctness is covered on the 8-device mesh by
     ``test_moe_inference_ep_sharded``."""
     import jax
     import numpy as np
@@ -285,37 +290,62 @@ def bench_moe_serving():
                                 size=(prompt_len,)).astype(np.int32)
                    for _ in range(slots)]
         b = ContinuousBatcher(eng, n_slots=slots)
-        ticks = 16 if on_tpu else 4
+        ticks = 64 if on_tpu else 4
         b.run(prompts, max_new_tokens=4, ticks=ticks)       # warm
+        b.warmup_windows(ticks)
         rates = []
         for _ in range(3):   # median: single ~1 s bursts are too noisy
             t0 = time.perf_counter()
             outs = b.run(prompts, max_new_tokens=new_toks, ticks=ticks)
             dt = time.perf_counter() - t0
             rates.append(sum(len(o) - prompt_len for o in outs) / dt)
+        # steady-state decode: admission RTT noise (~±100 ms/sync) is
+        # the same order as the moe-vs-dense margin (see bench_serving)
+        steady = []
+        steady_ticks = 64 if on_tpu else 4
+        for _ in range(3):
+            for p in prompts:
+                b.submit(p, max_new_tokens=new_toks - 1)
+            b.step(ticks=1)
+            t0 = time.perf_counter()
+            b.step(ticks=steady_ticks)
+            steady.append(slots * steady_ticks
+                          / (time.perf_counter() - t0))
+            while b.pending:
+                b.step(ticks=ticks)
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(params))
         del eng, b
-        return round(statistics.median(rates), 1), n_params
+        return (round(statistics.median(rates), 1),
+                round(statistics.median(steady), 1), n_params)
 
-    moe_tok_s, moe_params = run(MoEConfig(num_experts=experts, top_k=1))
-    dense_tok_s, dense_params = run(None)
+    moe_tok_s, moe_steady, moe_params = run(
+        MoEConfig(num_experts=experts, top_k=1))
+    dense_tok_s, dense_steady, dense_params = run(None)
     out = {"model": preset, "experts": experts,
            "moe_decode_tok_s": moe_tok_s,
+           "moe_decode_steady_tok_s": moe_steady,
            "dense_decode_tok_s": dense_tok_s,
+           "dense_decode_steady_tok_s": dense_steady,
            "moe_total_params_m": round(moe_params / 1e6, 1),
            "dense_total_params_m": round(dense_params / 1e6, 1),
            "vs_compute_matched_dense": round(moe_tok_s / dense_tok_s, 2)
-           if dense_tok_s else None}
+           if dense_tok_s else None,
+           "vs_compute_matched_dense_steady":
+           round(moe_steady / dense_steady, 2) if dense_steady else None}
     if on_tpu:
         # quality-matched baseline: a dense model in the MoE's total-
         # parameter class (the reference's "same quality, cheaper
         # serving" claim needs the MoE to beat THIS number)
-        big_tok_s, big_params = run(None, model_preset="gpt2-350m")
+        big_tok_s, big_steady, big_params = run(
+            None, model_preset="gpt2-350m")
         out["dense_350m_decode_tok_s"] = big_tok_s
+        out["dense_350m_decode_steady_tok_s"] = big_steady
         out["dense_350m_total_params_m"] = round(big_params / 1e6, 1)
         out["vs_quality_matched_dense"] = \
             round(moe_tok_s / big_tok_s, 2) if big_tok_s else None
+        out["vs_quality_matched_dense_steady"] = \
+            round(moe_steady / big_steady, 2) if big_steady else None
     return out
 
 
