@@ -18,6 +18,7 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
+from . import telemetry  # noqa: F401  (metrics registry / tracer / watchdog)
 from .parallel import zero  # noqa: F401  (deepspeed.zero.Init parity namespace)
 from .comm import init_distributed  # noqa: F401
 from .runtime.config import Config, DeepSpeedConfig  # noqa: F401

@@ -34,6 +34,7 @@ from .. import comm
 from ..comm.mesh import MeshConfig, build_mesh, set_mesh
 from ..models.common import TP_RULES
 from ..parallel import zero as zero_lib
+from ..telemetry import recompile, trace
 from ..utils import log_dist
 from ..utils.logging import logger
 
@@ -281,7 +282,9 @@ class InferenceEngine:
         def fwd(params, input_ids):
             return self._fwd_model.apply({"params": params}, input_ids)["logits"]
 
-        return jax.jit(fwd)
+        # caller-shaped inputs vary by design: count compiles, no warning
+        return recompile.watch(jax.jit(fwd), name="inference.forward",
+                               warn=False)
 
     def forward(self, input_ids, **kwargs):
         if self.params is None:
@@ -299,7 +302,10 @@ class InferenceEngine:
                 position_ids=position_ids, mutable=["cache"])
             return out["logits"], vars_["cache"]
 
-        return jax.jit(prefill)
+        # chunked prefill compiles one executable per pow2 chunk length
+        # and batch width BY DESIGN — counted, never warned
+        return recompile.watch(jax.jit(prefill), name="inference.prefill",
+                               warn=False)
 
     @functools.lru_cache(maxsize=16)
     def _compiled_decode_step(self, top_k: int, top_p: float,
@@ -316,7 +322,12 @@ class InferenceEngine:
         emit ``pad_id`` from then on), ``eos_id`` < 0 disables EOS.
         """
         tick = self._decode_tick(top_k, top_p, temperature)
-        return jax.jit(tick)
+        # batch width B legitimately varies across generate() calls (same
+        # as generate_loop below) → counted, not warned; the continuously-
+        # batched serving hot loop has its own fixed-width watchdog sites
+        # (serving.decode[...]) that DO warn
+        return recompile.watch(jax.jit(tick), name="inference.decode_step",
+                               warn=False)
 
     def _decode_tick(self, top_k: int, top_p: float, temperature: float):
         """ONE decode tick as a pure function — the single source of truth
@@ -362,7 +373,11 @@ class InferenceEngine:
                 body, (cache, token, seen_mask, done, rng), steps)
             return toks   # (n, B)
 
-        return jax.jit(run)
+        # (B, max_new_tokens) legitimately vary across generate() calls:
+        # counted (watch the counter to spot an unbucketed caller), not
+        # warned — the per-tick hot path is covered by decode_step
+        return recompile.watch(jax.jit(run), name="inference.generate_loop",
+                               warn=False)
 
     @staticmethod
     def _seen_mask_from(input_ids, vocab_size: int):
@@ -424,9 +439,11 @@ class InferenceEngine:
             raise ValueError(f"prompt({S}) + max_new_tokens({max_new_tokens}) "
                              f"exceeds the generation limit {limit} "
                              f"(max_tokens/model context)")
-        cache = self.init_cache(B)
-        positions = jnp.arange(S)[None, :].repeat(B, 0)
-        logits, cache = self._compiled_prefill(self.params, cache, input_ids, positions)
+        with trace.span("serve/prefill", rows=int(B), len=int(S)):
+            cache = self.init_cache(B)
+            positions = jnp.arange(S)[None, :].repeat(B, 0)
+            logits, cache = self._compiled_prefill(
+                self.params, cache, input_ids, positions)
         rng = jax.random.PRNGKey(seed)
         rep_pen = jnp.float32(repetition_penalty)
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
@@ -445,9 +462,11 @@ class InferenceEngine:
         if compiled_loop and max_new_tokens > 1:
             loop = self._compiled_generate_loop(
                 int(top_k), float(top_p), float(temperature))
-            toks = loop(self.params, cache, token[:, None],
-                        jnp.full((B,), S, jnp.int32), rng, rep_pen, seen,
-                        done, eos, pad, jnp.arange(max_new_tokens - 1))
+            with trace.span("serve/decode-tick", ticks=max_new_tokens - 1,
+                            rows=int(B)):
+                toks = loop(self.params, cache, token[:, None],
+                            jnp.full((B,), S, jnp.int32), rng, rep_pen, seen,
+                            done, eos, pad, jnp.arange(max_new_tokens - 1))
             return jnp.concatenate([input_ids, token[:, None], toks.T], axis=1)
         decode_step = self._compiled_decode_step(
             int(top_k), float(top_p), float(temperature))

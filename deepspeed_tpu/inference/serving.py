@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import recompile, registry as telemetry_registry, trace
 from .engine import InferenceEngine, _sample
 
 
@@ -99,10 +100,17 @@ class ContinuousBatcher:
         self._queue: deque = deque()
         # prefill-ahead (the TTFT lever): queued requests are prefilled
         # and their FIRST token sampled while every slot is still busy;
-        # the 1-row cache parks here until a slot frees.  TTFT becomes
+        # the results park here until a slot frees.  TTFT becomes
         # queueing-for-prefill + prefill, decoupled from how long the
-        # current wave keeps decoding.  ``prefill_ahead`` bounds parked
-        # caches (HBM: one gen-limit KV cache each); 0 disables.
+        # current wave keeps decoding.  HBM residency: a batched prefill's
+        # parked rows share ONE B-row gen-limit KV cache BY REFERENCE, and
+        # that whole cache stays live until its LAST row is placed — so
+        # one slow-to-place row pins all B rows (worst case ``B × one
+        # gen-limit cache``, not one).  ``_shrink_parked`` trims the tail:
+        # once a batch is down to a single pending row, that row is
+        # sliced into its own 1-row cache and the B-row buffer is
+        # released.  ``prefill_ahead`` bounds how many rows may park at
+        # once; 0 disables.
         self._parked: deque = deque()
         self.prefill_ahead = n_slots if prefill_ahead is None \
             else int(prefill_ahead)
@@ -119,6 +127,22 @@ class ContinuousBatcher:
         self._t_submit: Dict[int, float] = {}
         self._t_first: Dict[int, float] = {}
         self._lat: deque = deque(maxlen=4096)
+        # registry surface (telemetry/registry.py): counters/histograms a
+        # scraper reads without calling latency_stats()
+        self._m_submitted = telemetry_registry.counter(
+            "serving_requests_submitted_total", "requests accepted")
+        self._m_completed = telemetry_registry.counter(
+            "serving_requests_completed_total", "requests retired")
+        self._m_ticks = telemetry_registry.counter(
+            "serving_decode_ticks_total", "decode ticks executed")
+        self._m_ttft = telemetry_registry.histogram(
+            "serving_ttft_seconds", "submit -> first token on host")
+        self._m_e2e = telemetry_registry.histogram(
+            "serving_e2e_seconds", "submit -> retirement")
+        self._m_active = telemetry_registry.gauge(
+            "serving_active_slots", "occupied decode slots")
+        self._m_queue = telemetry_registry.gauge(
+            "serving_queue_depth", "queued + parked requests")
 
         decode_model = engine._decode_model
         top_k_static = self.top_k
@@ -177,7 +201,12 @@ class ContinuousBatcher:
                     jnp.arange(ticks))
                 return toks, cache, token, pos, seen, done
 
-            return jax.jit(run)
+            # each (ticks, greedy) window is its own executable BY DESIGN;
+            # per-window watchdog names so only intra-window drift (cache/
+            # sampling-state shape changes) counts as a hot-loop recompile
+            return recompile.watch(
+                jax.jit(run),
+                name=f"serving.decode[{ticks}{'g' if greedy else 's'}]")
 
         self._multi_step = multi_step
 
@@ -201,9 +230,23 @@ class ContinuousBatcher:
         # one tunnel round-trip per request (round-4: ~1.4 s of the 1.8 s
         # TTFT was 8 sequential syncs) — the batch samples in ONE call and
         # the caller fetches every first token in ONE device_get
-        self._first_token_batch = jax.jit(jax.vmap(first_token_fn))
+        self._first_token_batch = recompile.watch(
+            jax.jit(jax.vmap(first_token_fn)),
+            name="serving.first_token", warn=False)   # varies per width
 
         cache_bdims = self._cache_bdims
+
+        def slice_parked_row(cacheB, firstB, seen1B, row):
+            """Row ``row`` of a parked B-row prefill batch as 1-row
+            arrays — the ONE slicing convention shared by placement and
+            the shrink path (divergence would extract a stale row)."""
+            cache1 = jax.tree_util.tree_map(
+                lambda l, bd: l if bd is None
+                else jax.lax.dynamic_slice_in_dim(l, row, 1, bd),
+                cacheB, cache_bdims)
+            first1 = jax.lax.dynamic_slice_in_dim(firstB, row, 1, 0)
+            seen1 = jax.lax.dynamic_slice_in_dim(seen1B, row, 1, 0)
+            return cache1, first1, seen1
 
         def place_fn(cache, token, pos, temp, top_p, rep, seen, done,
                      cacheB, firstB, seen1B, row, prompt_len, i,
@@ -211,12 +254,10 @@ class ContinuousBatcher:
             # row-extraction happens HERE, inside the jit: slicing the
             # parked batch eagerly costs one tunneled dispatch per cache
             # leaf per request (round-4: ~0.5 s of every prefill batch)
-            cache1 = jax.tree_util.tree_map(
-                lambda l, bd: l if bd is None
-                else jax.lax.dynamic_slice_in_dim(l, row, 1, bd),
-                cacheB, cache_bdims)
-            first = jax.lax.dynamic_slice_in_dim(firstB, row, 1, 0)[0]
-            seen1 = jax.lax.dynamic_slice_in_dim(seen1B, row, 1, 0)[0]
+            cache1, first1, seen1B_row = slice_parked_row(
+                cacheB, firstB, seen1B, row)
+            first = first1[0]
+            seen1 = seen1B_row[0]
 
             def put(big, small):
                 return jax.lax.dynamic_update_slice(
@@ -233,7 +274,16 @@ class ContinuousBatcher:
             done = put(done, first == jnp.int32(self.eos))
             return cache, token, pos, temp, top_p, rep, seen, done
 
-        self._place_fn = jax.jit(place_fn)
+        # one executable per parked-batch width (B-row cacheB operand)
+        self._place_fn = recompile.watch(jax.jit(place_fn),
+                                         name="serving.place", warn=False)
+
+        # last-pending-row extraction (see _shrink_parked): slice one row
+        # of a parked B-row prefill batch into standalone 1-row arrays so
+        # the B-row cache can be freed; one executable per batch width
+        self._extract_row_fn = recompile.watch(
+            jax.jit(slice_parked_row), name="serving.extract_row",
+            warn=False)
 
         # retire: freeze the slot AND rewind its pos/cache_index to 0, so a
         # frozen slot's continued (discarded) decode writes at position 0
@@ -253,7 +303,8 @@ class ContinuousBatcher:
 
             return done, pos, jax.tree_util.tree_map_with_path(reset, cache)
 
-        self._retire_fn = jax.jit(retire_fn, donate_argnums=(2,))
+        self._retire_fn = recompile.watch(
+            jax.jit(retire_fn, donate_argnums=(2,)), name="serving.retire")
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
@@ -274,6 +325,8 @@ class ContinuousBatcher:
         self._queue.append(Request(uid, prompt, max_new_tokens,
                                    temperature, top_p, repetition_penalty))
         self._t_submit[uid] = time.perf_counter()
+        self._m_submitted.inc()
+        self._m_queue.set(len(self._queue) + len(self._parked))
         return uid
 
     @property
@@ -294,23 +347,24 @@ class ContinuousBatcher:
         stays exact (no pad pollution).  Returns (last-chunk logits,
         cache)."""
         eng = self.engine
-        cache = eng.init_cache(ids.shape[0])
         S = ids.shape[1]
-        if not self.chunked_prefill:
-            return eng._compiled_prefill(eng.params, cache, ids,
-                                         jnp.arange(S)[None, :])
-        pos = 0
-        logits = None
-        chunk = 1 << (S.bit_length() - 1)
-        while chunk:
-            if S & chunk:
-                seg = ids[:, pos:pos + chunk]
-                positions = (pos + jnp.arange(chunk))[None, :]
-                logits, cache = eng._compiled_prefill(eng.params, cache,
-                                                      seg, positions)
-                pos += chunk
-            chunk >>= 1
-        return logits, cache
+        with trace.span("serve/prefill", rows=int(ids.shape[0]), len=int(S)):
+            cache = eng.init_cache(ids.shape[0])
+            if not self.chunked_prefill:
+                return eng._compiled_prefill(eng.params, cache, ids,
+                                             jnp.arange(S)[None, :])
+            pos = 0
+            logits = None
+            chunk = 1 << (S.bit_length() - 1)
+            while chunk:
+                if S & chunk:
+                    seg = ids[:, pos:pos + chunk]
+                    positions = (pos + jnp.arange(chunk))[None, :]
+                    logits, cache = eng._compiled_prefill(eng.params, cache,
+                                                          seg, positions)
+                    pos += chunk
+                chunk >>= 1
+            return logits, cache
 
     def _prefill_batch(self, max_new: int):
         """Prefill up to ``max_new`` queued requests and PARK the results.
@@ -357,15 +411,25 @@ class ContinuousBatcher:
                 self._parked.append(
                     (req, cacheB, row, firstB, seen1B, first_host))
 
+    def _record_latency(self, uid: int) -> None:
+        """Collapse a retired request's in-flight timestamps into the
+        bounded (ttft, e2e) window and the registry histograms."""
+        t_sub = self._t_submit.pop(uid, None)
+        t_first = self._t_first.pop(uid, None)
+        self._m_completed.inc()
+        if t_sub is None:
+            return
+        now = time.perf_counter()
+        ttft = t_first - t_sub if t_first is not None else float("nan")
+        e2e = now - t_sub
+        self._lat.append((ttft, e2e))
+        self._m_ttft.observe(ttft)   # NaN observations are dropped
+        self._m_e2e.observe(e2e)
+
     def _finish_unslotted(self, req: Request, emitted: List[int]):
         self._finished[req.uid] = np.concatenate(
             [req.prompt, np.asarray(emitted, np.int32)])
-        t_sub = self._t_submit.pop(req.uid, None)
-        t_first = self._t_first.pop(req.uid, None)
-        if t_sub is not None:
-            now = time.perf_counter()
-            self._lat.append((t_first - t_sub if t_first is not None
-                              else float("nan"), now - t_sub))
+        self._record_latency(req.uid)
 
     def _admit(self):
         """Place parked (already-prefilled) requests into free slots;
@@ -385,18 +449,33 @@ class ContinuousBatcher:
                     cacheB, firstB, seen1B, row, len(req.prompt), i,
                     req.temperature, req.top_p, req.repetition_penalty)
             self._slots[i] = _Active(req, [first_host])
+        self._shrink_parked()
+
+    def _shrink_parked(self):
+        """Release B-row prefill buffers that only one parked row still
+        pins: parked entries hold their batch's cache BY REFERENCE, so the
+        whole B-row cache (B gen-limit KV caches of HBM) stays live until
+        its last row places.  Once a batch is down to ONE pending row,
+        slice that row into a standalone 1-row cache and drop the batch
+        reference — worst-case parked residency falls from B rows to 1
+        per drained batch.  (One extra device dispatch per batch, paid
+        only when B > 1.)"""
+        refs: Dict[int, int] = {}
+        for entry in self._parked:
+            refs[id(entry[1])] = refs.get(id(entry[1]), 0) + 1
+        for idx, entry in enumerate(self._parked):
+            req, cacheB, row, firstB, seen1B, first_host = entry
+            if refs[id(cacheB)] == 1 and int(firstB.shape[0]) > 1:
+                cache1, first1, seen1 = self._extract_row_fn(
+                    cacheB, firstB, seen1B, row)
+                self._parked[idx] = (req, cache1, 0, first1, seen1,
+                                     first_host)
 
     def _retire(self, i: int):
         act = self._slots[i]
         self._finished[act.req.uid] = np.concatenate(
             [act.req.prompt, np.asarray(act.emitted, np.int32)])
-        uid = act.req.uid
-        t_sub = self._t_submit.pop(uid, None)
-        t_first = self._t_first.pop(uid, None)
-        if t_sub is not None:
-            now = time.perf_counter()
-            self._lat.append((t_first - t_sub if t_first is not None
-                              else float("nan"), now - t_sub))
+        self._record_latency(act.req.uid)
         self._slots[i] = None
         self._done, self._pos, self._cache = self._retire_fn(
             self._done, self._pos, self._cache, i)
@@ -425,10 +504,15 @@ class ContinuousBatcher:
         before = set(self._finished)
         remaining = int(ticks)
         while remaining > 0:
-            self._admit()
-            if self.prefill_ahead and self._queue:
-                self._prefill_batch(self.prefill_ahead - len(self._parked))
+            with trace.span("serve/admission",
+                            queued=len(self._queue), parked=len(self._parked)):
+                self._admit()
+                if self.prefill_ahead and self._queue:
+                    self._prefill_batch(
+                        self.prefill_ahead - len(self._parked))
             active = [a for a in self._slots if a is not None]
+            self._m_active.set(len(active))
+            self._m_queue.set(len(self._queue) + len(self._parked))
             if not active:
                 break
             sub = remaining
@@ -452,15 +536,20 @@ class ContinuousBatcher:
                               1 << (remaining.bit_length() - 1))
             slot_ids = jnp.arange(self.n_slots)
             greedy = all(a.req.temperature <= 0.0 for a in active)
-            toks, self._cache, self._token, self._pos, self._seen, done = \
-                self._multi_step(int(sub), greedy)(
-                    self.engine.params, self._cache, self._token, self._pos,
-                    slot_ids, self._temp, self._top_p, self._rep, self._seen,
-                    self._done, jnp.int32(self._tick_no), jnp.int32(self.eos),
-                    jnp.int32(self.pad))
-            self._tick_no += int(sub)
-            self._done = done
-            tok_h = np.asarray(jax.device_get(toks))[:, :, 0]  # (sub, slots)
+            with trace.span("serve/decode-tick", ticks=int(sub),
+                            active=len(active)):
+                toks, self._cache, self._token, self._pos, self._seen, \
+                    done = self._multi_step(int(sub), greedy)(
+                        self.engine.params, self._cache, self._token,
+                        self._pos, slot_ids, self._temp, self._top_p,
+                        self._rep, self._seen, self._done,
+                        jnp.int32(self._tick_no), jnp.int32(self.eos),
+                        jnp.int32(self.pad))
+                self._tick_no += int(sub)
+                self._done = done
+                # the fetch is part of the tick's host wall time
+                tok_h = np.asarray(jax.device_get(toks))[:, :, 0]
+            self._m_ticks.inc(int(sub))
             for t in range(int(sub)):
                 for i, act in enumerate(self._slots):
                     if act is None:
@@ -519,6 +608,14 @@ class ContinuousBatcher:
         def pct(xs, q):
             return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("nan")
 
-        return {"n": len(self._lat),
-                "ttft_p50_s": pct(ttfts, 0.50), "ttft_p90_s": pct(ttfts, 0.90),
-                "e2e_p50_s": pct(e2es, 0.50), "e2e_p90_s": pct(e2es, 0.90)}
+        stats = {"n": len(self._lat),
+                 "ttft_p50_s": pct(ttfts, 0.50), "ttft_p90_s": pct(ttfts, 0.90),
+                 "e2e_p50_s": pct(e2es, 0.50), "e2e_p90_s": pct(e2es, 0.90)}
+        # mirror the percentile view into the registry (histograms carry
+        # the full distributions; these gauges are the human-named cut)
+        for key, value in stats.items():
+            if key != "n" and value == value:
+                telemetry_registry.gauge(
+                    f"serving_{key}", "latency percentile snapshot"
+                ).set(value)
+        return stats

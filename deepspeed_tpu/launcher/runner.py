@@ -91,6 +91,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch the job this many times after a failure "
                         "(workers resume via load_checkpoint)")
+    p.add_argument("--metrics_dir", type=str, default=None,
+                   help="directory for per-rank telemetry dumps: each "
+                        "worker writes metrics_rank<k>.json (a registry "
+                        "snapshot, see telemetry/registry.py) on exit")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p
@@ -176,6 +180,8 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
                    DSTPU_COORDINATOR=coord,
                    DSTPU_NUM_PROCESSES=str(args.num_processes),
                    DSTPU_PROCESS_ID=str(pid_idx))
+        if args.metrics_dir:
+            env["DSTPU_METRICS_DIR"] = args.metrics_dir
         if hb_dir:
             hb = os.path.join(hb_dir, f"hb_{pid_idx}")
             env["DSTPU_HEARTBEAT_FILE"] = hb
@@ -237,11 +243,13 @@ def _launch_hostfile(args) -> int:
     host_list = list(hosts)
     coord = f"{host_list[0]}:{args.coordinator_port}"
     procs = []
+    metrics_env = f"DSTPU_METRICS_DIR={shlex.quote(args.metrics_dir)} " \
+        if args.metrics_dir else ""
     for idx, host in enumerate(host_list):
         remote_cmd = (
             f"cd {shlex.quote(os.getcwd())} && "
             f"DSTPU_COORDINATOR={coord} DSTPU_NUM_PROCESSES={len(host_list)} "
-            f"DSTPU_PROCESS_ID={idx} "
+            f"DSTPU_PROCESS_ID={idx} {metrics_env}"
             f"{shlex.quote(sys.executable)} {shlex.quote(args.user_script)} "
             + " ".join(map(shlex.quote, args.user_args)))
         cmd = ["ssh", "-p", str(args.ssh_port), host, remote_cmd]
@@ -281,6 +289,8 @@ def main(argv=None) -> int:
                                f"{attempt + 1}/{args.max_restarts}")
         return rc
     # single process: exec in place (the common TPU case — one proc/host)
+    if args.metrics_dir:
+        os.environ["DSTPU_METRICS_DIR"] = args.metrics_dir
     os.execv(sys.executable, [sys.executable, args.user_script] + args.user_args)
 
 

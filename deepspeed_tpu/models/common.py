@@ -128,6 +128,19 @@ def offloadable_policy_name(name: str) -> str:
         return name
     parts = name.split("+")
     if parts[0] in ("nothing_saveable", "everything_saveable"):
+        if parts[0] == "everything_saveable":
+            # save-everything -> recompute-most is a real behavioral
+            # downgrade, not just a representation change: warn HERE so
+            # the functional checkpoint()/_policy() path surfaces it too
+            # (the engine config path additionally logs its upgrade)
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "cpu_checkpointing: remat policy 'everything_saveable' "
+                "has no offloadable saveables; downgrading to "
+                "'dots_with_no_batch_dims_saveable+offload' — dots with "
+                "batch dims (and everything else non-dot) will be "
+                "RECOMPUTED, not saved")
         name = "dots_with_no_batch_dims_saveable" + \
             "".join("+" + p for p in parts[1:])
     return name + "+offload"

@@ -15,6 +15,36 @@ from ..runtime.config import MonitorConfig
 from ..utils.logging import logger
 
 
+class _RegistryWriter:
+    """Telemetry-registry sink: every ``write_events`` call ALSO lands in
+    the process-wide metrics registry (``telemetry/registry.py``), so
+    monitor events are scrapeable (Prometheus text / JSON snapshot)
+    without configuring any external writer.  Event labels become label
+    values of one ``monitor_event`` gauge family; the step rides along as
+    ``monitor_event_samples`` so exporters can see staleness."""
+
+    def __init__(self):
+        from ..telemetry import registry as _reg
+
+        self._events_total = _reg.counter(
+            "monitor_events_total", "events fanned out via MonitorMaster")
+        self._event = _reg.gauge(
+            "monitor_event", "latest value per monitor event label",
+            labelnames=("label",))
+        self._event_step = _reg.gauge(
+            "monitor_event_samples", "global_samples at the latest event",
+            labelnames=("label",))
+
+    def write_events(self, event_list):
+        for label, value, step in event_list:
+            self._event.labels(label=str(label)).set(float(value))
+            self._event_step.labels(label=str(label)).set(float(step))
+        self._events_total.inc(len(event_list))
+
+    def close(self):
+        pass
+
+
 class _CsvWriter:
     """Reference ``monitor/csv_monitor.py`` analog: one CSV per label."""
 
@@ -102,6 +132,10 @@ class _WandbWriter:
 class MonitorMaster:
     def __init__(self, config: MonitorConfig):
         self.writers = []
+        # the registry sink is unconditional (in-process, no I/O) but NOT
+        # in ``writers``: ``enabled`` keeps meaning "an external writer is
+        # configured" so callers' fetch-and-write gating is unchanged
+        self._registry_sink = _RegistryWriter()
         self._rank0 = self._is_rank0()
         if not self._rank0:
             return
@@ -126,6 +160,7 @@ class MonitorMaster:
         return bool(self.writers)
 
     def write_events(self, event_list):
+        self._registry_sink.write_events(event_list)
         for w in self.writers:
             w.write_events(event_list)
 

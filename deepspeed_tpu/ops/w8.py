@@ -2,10 +2,17 @@
 
 Real int8 — not fake-quant: weights live in HBM as int8 codes plus
 per-(group, out-channel) fp32 scales (half the bytes of bf16, quarter of
-fp32), and the matmul consumes the codes directly; dequantization happens
-on-chip inside the fused contraction, never materializing a full-width
-weight tensor.  Decode is HBM-bandwidth-bound, so halving weight bytes is
-a direct decode-throughput lever.  The analog of the reference's int8
+fp32).  In the decode regime (M ≤ 64 activation rows) the matmul consumes
+the codes directly; dequantization happens on-chip inside the fused
+contraction, never materializing a full-width weight tensor.  The
+prefill regime (M > 64) instead materializes a TRANSIENT dequantized
+(K, N) panel per call BY DESIGN — a plain MXU dot over a dequantized
+temp beats the grouped einsum's (…, G, N) fp32 partials there (int8
+prefill ran 2.3× fp TTFT before the switch, round-5) — so the
+int8-storage claim holds for HBM-RESIDENT weights; transient compute
+temps may be full width.  Decode is HBM-bandwidth-bound, so halving
+stored weight bytes is a direct decode-throughput lever.  The analog of
+the reference's int8
 inference GEMMs + dequant kernels
 (``/root/reference/csrc/transformer/inference/csrc/pt_binding.cpp:622,709,770``
 ``ds_qkv_gemm_int8`` / ``ds_vector_matmul_int8`` and ``dequantize.cu``),

@@ -33,6 +33,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..telemetry.trace import device_span
+
+# Stage boundaries are INSIDE the compiled scan, where host spans cannot
+# measure anything — device_span (jax.named_scope) stamps the stage /
+# loss-head / ring phases into HLO op metadata instead, so XLA profiles
+# and compiler dumps attribute pipeline time to the right phase.
+
 
 def _pvary(x, axis):
     return jax.tree_util.tree_map(
@@ -77,20 +84,25 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
         # across the ring, not per stage; the predicate is uniform within
         # each stage's dp/tp group so the branches stay collective-safe)
         mb_in = pick_mb(t)
-        x = lax.cond(sid == 0,
-                     lambda: embed_fn(shared_params, mb_in),
-                     lambda: x_buf)
-        y = stage_fn(stage_params, x)
+        with device_span("pipe_embed"):
+            x = lax.cond(sid == 0,
+                         lambda: embed_fn(shared_params, mb_in),
+                         lambda: x_buf)
+        with device_span("pipe_stage_fwd"):
+            y = stage_fn(stage_params, x)
         # last stage emits microbatch t-(S-1) when valid; the E×V loss
         # head likewise runs only where/when it is consumed
         out_t = t - (S - 1)
         mb_out = pick_mb(out_t)
         valid = jnp.logical_and(sid == S - 1,
                                 jnp.logical_and(out_t >= 0, out_t < M))
-        loss_acc = loss_acc + lax.cond(
-            valid, lambda: loss_fn(shared_params, y, mb_out),
-            lambda: jnp.float32(0.0))
-        x_next = lax.ppermute(y, axis, [(i, (i + 1) % S) for i in range(S)])
+        with device_span("pipe_loss_head"):
+            loss_acc = loss_acc + lax.cond(
+                valid, lambda: loss_fn(shared_params, y, mb_out),
+                lambda: jnp.float32(0.0))
+        with device_span("pipe_ring"):
+            x_next = lax.ppermute(y, axis,
+                                  [(i, (i + 1) % S) for i in range(S)])
         return (x_next, loss_acc), None
 
     (x_fin, loss_sum), _ = lax.scan(tick, (x0, loss0), jnp.arange(T))
@@ -163,10 +175,12 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
         mb_f = pick_mb(f)
         # embed under lax.cond: ONE embed per microbatch (stage 0), the
         # other stages take the buffer branch at run time
-        x = lax.cond(sid == 0,
-                     lambda: embed_fn(shared_params, mb_f),
-                     lambda: fwd_in)
-        y = stage_fn(stage_params, x)
+        with device_span("pipe_embed"):
+            x = lax.cond(sid == 0,
+                         lambda: embed_fn(shared_params, mb_f),
+                         lambda: fwd_in)
+        with device_span("pipe_stage_fwd"):
+            y = stage_fn(stage_params, x)
         slot_f = jnp.mod(jnp.maximum(f, 0), D)
         resid = jnp.where(
             do_fwd, lax.dynamic_update_index_in_dim(resid, x, slot_f, 0),
@@ -178,7 +192,8 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
         mb_k = pick_mb(k)
         x_k = lax.dynamic_index_in_dim(
             resid, jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
-        y_k, stage_vjp = jax.vjp(stage_fn, stage_params, x_k)
+        with device_span("pipe_stage_bwd"):
+            y_k, stage_vjp = jax.vjp(stage_fn, stage_params, x_k)
         is_last = sid == S - 1
 
         # E×V loss head fwd+bwd only where it is consumed (last stage,
@@ -218,8 +233,11 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
         loss_acc = loss_acc + loss_k
 
         # ---- ring: activations down, cotangents up ----
-        fwd_next = lax.ppermute(y, axis, [(i, (i + 1) % S) for i in range(S)])
-        ct_next = lax.ppermute(ct_x, axis, [(i, (i - 1) % S) for i in range(S)])
+        with device_span("pipe_ring"):
+            fwd_next = lax.ppermute(
+                y, axis, [(i, (i + 1) % S) for i in range(S)])
+            ct_next = lax.ppermute(
+                ct_x, axis, [(i, (i - 1) % S) for i in range(S)])
         return (fwd_next, ct_next, resid, g_sh, g_st, loss_acc), None
 
     carry0 = (x0, ct0, resid0, g_sh0, g_st0, loss0)
@@ -400,10 +418,12 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
             mb_f = pick_mb(f)
             x = fwd_buf[v]
             if v == 0:                # only global chunk 0 ingests tokens
-                x = lax.cond(sid == 0,
-                             lambda: embed_fn(shared_params, mb_f),
-                             lambda: fwd_buf[0])
-            ys.append(sfn_v(params_v, x))
+                with device_span("pipe_embed"):
+                    x = lax.cond(sid == 0,
+                                 lambda: embed_fn(shared_params, mb_f),
+                                 lambda: fwd_buf[0])
+            with device_span(f"pipe_chunk{v}_fwd"):
+                ys.append(sfn_v(params_v, x))
             slot_f = jnp.mod(jnp.maximum(f, 0), D)
             resid = jnp.where(
                 do_fwd,
@@ -416,7 +436,8 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
             mb_k = pick_mb(k)
             x_k = lax.dynamic_index_in_dim(
                 resid[v], jnp.mod(jnp.maximum(k, 0), D), 0, keepdims=False)
-            y_k, stage_vjp = jax.vjp(sfn_v, params_v, x_k)
+            with device_span(f"pipe_chunk{v}_bwd"):
+                y_k, stage_vjp = jax.vjp(sfn_v, params_v, x_k)
             if v == V - 1:            # final chunk: loss head seeds ct
                 is_final = sid == S - 1
 
@@ -469,8 +490,11 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
 
         ys = jnp.stack(ys)            # (V, ...)
         cts = jnp.stack(cts)
-        down = lax.ppermute(ys, axis, [(i, (i + 1) % S) for i in range(S)])
-        up = lax.ppermute(cts, axis, [(i, (i - 1) % S) for i in range(S)])
+        with device_span("pipe_ring"):
+            down = lax.ppermute(ys, axis,
+                                [(i, (i + 1) % S) for i in range(S)])
+            up = lax.ppermute(cts, axis,
+                              [(i, (i - 1) % S) for i in range(S)])
         fwd_buf = jnp.where(sid == 0, jnp.roll(down, 1, axis=0), down)
         ct_buf = jnp.where(sid == S - 1, jnp.roll(up, -1, axis=0), up)
         return (fwd_buf, ct_buf, resid, g_sh, g_st, loss_acc), None

@@ -38,6 +38,7 @@ from .. import comm
 from ..comm.mesh import DATA_AXES, MeshConfig, build_mesh, data_parallel_size, set_mesh
 from ..models.common import TP_RULES
 from ..parallel import zero as zero_lib
+from ..telemetry import recompile, registry as telemetry_registry, trace
 from ..utils import ThroughputTimer, log_dist, logger
 from . import precision
 from .config import Config
@@ -991,10 +992,20 @@ class Engine:
 
         return step_fn
 
+    @property
+    def _hot_loop_shapes_static(self) -> bool:
+        """False when the train step's batch shapes vary BY DESIGN —
+        curriculum learning truncates the seq dim per scheduled
+        difficulty, so each pow2 bucket is a legitimate fresh executable,
+        not a recompile to page anyone about."""
+        return self.curriculum_scheduler is None
+
     @functools.cached_property
     def _compiled_train_step(self):
-        return jax.jit(self._train_step_body, donate_argnums=(0,),
-                       out_shardings=(self._state_shardings, None))
+        return recompile.watch(
+            jax.jit(self._train_step_body, donate_argnums=(0,),
+                    out_shardings=(self._state_shardings, None)),
+            name="engine.train_step", warn=self._hot_loop_shapes_static)
 
     def _compiled_multi_step(self, steps: int, stacked: bool):
         """``steps`` optimizer steps as ONE compiled scan — one host
@@ -1029,9 +1040,11 @@ class Engine:
                                     length=steps,
                                     unroll=min(unroll, steps))
 
-            cache[key] = jax.jit(
-                multi, donate_argnums=(0,),
-                out_shardings=(self._state_shardings, None))
+            cache[key] = recompile.watch(
+                jax.jit(multi, donate_argnums=(0,),
+                        out_shardings=(self._state_shardings, None)),
+                name=f"engine.multi_step[{steps}]",
+                warn=self._hot_loop_shapes_static)
         return cache[key]
 
     def train_batches(self, batch, steps: int, stacked: Optional[bool] = None):
@@ -1148,8 +1161,10 @@ class Engine:
                     if np.ndim(x) > seq_dim else x, seg)
             seg_thetas = None if thetas is None \
                 else jnp.asarray(thetas[seg_start:seg_stop])
-            self._state, (losses, ovs) = self._compiled_multi_step(
-                n, stacked)(self._state, seg, seg_thetas)
+            with trace.span("train/fwd-bwd", step=self.global_steps,
+                            steps=n):
+                self._state, (losses, ovs) = self._compiled_multi_step(
+                    n, stacked)(self._state, seg, seg_thetas)
             all_losses.append(losses)
             overflows.append(ovs)
             beat()
@@ -1393,7 +1408,10 @@ class Engine:
                 params = self._to_canonical_params(params)
             return self._loss_fn(params, batch, None, deterministic=True)
 
-        return jax.jit(eval_fn)
+        # eval batch shapes legitimately vary with the caller → no warning,
+        # but the compile population still lands in the registry
+        return recompile.watch(jax.jit(eval_fn), name="engine.eval_step",
+                               warn=False)
 
     @functools.cached_property
     def _compiled_grad_step(self):
@@ -1413,7 +1431,7 @@ class Engine:
             grads = self._constrain(grads, self._grad_specs)
             return loss / scale, grads
 
-        return jax.jit(grad_fn)
+        return recompile.watch(jax.jit(grad_fn), name="engine.grad_step")
 
     @functools.cached_property
     def _compiled_apply_step(self):
@@ -1422,8 +1440,10 @@ class Engine:
             return self._apply_grads(state, grad_sum, loss_sum, denom,
                                      loss_is_scaled=False)
 
-        return jax.jit(apply_fn, donate_argnums=(0, 1),
-                       out_shardings=(self._state_shardings, None))
+        return recompile.watch(
+            jax.jit(apply_fn, donate_argnums=(0, 1),
+                    out_shardings=(self._state_shardings, None)),
+            name="engine.apply_step")
 
     # ------------------------------------------------------------------
     # public API
@@ -1484,21 +1504,24 @@ class Engine:
         if self._param_offload is None:
             self._require_state()
         if batch is None:
-            if data_iter is None:
-                data_iter = self._train_iter()
-            micros = [next(data_iter) for _ in range(self.gradient_accumulation_steps)]
-            batch = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0), *micros)
-            # loader yields rank-contiguous micro-batches; interleave to the
-            # rank-major layout _split_microbatches expects
-            dpw, gas = self.dp_world, self.gradient_accumulation_steps
-            def relayout(x):
-                b = x.shape[0]
-                micro = b // (dpw * gas)
-                y = x.reshape(gas, dpw, micro, *x.shape[1:])
-                return (y.transpose(1, 0, 2, *range(3, y.ndim))
-                         .reshape(b, *x.shape[1:]))
-            batch = jax.tree_util.tree_map(relayout, batch)
+            with trace.span("train/load-batch", step=self.global_steps):
+                if data_iter is None:
+                    data_iter = self._train_iter()
+                micros = [next(data_iter)
+                          for _ in range(self.gradient_accumulation_steps)]
+                batch = jax.tree_util.tree_map(
+                    lambda *xs: np.concatenate(
+                        [np.asarray(x) for x in xs], axis=0), *micros)
+                # loader yields rank-contiguous micro-batches; interleave
+                # to the rank-major layout _split_microbatches expects
+                dpw, gas = self.dp_world, self.gradient_accumulation_steps
+                def relayout(x):
+                    b = x.shape[0]
+                    micro = b // (dpw * gas)
+                    y = x.reshape(gas, dpw, micro, *x.shape[1:])
+                    return (y.transpose(1, 0, 2, *range(3, y.ndim))
+                             .reshape(b, *x.shape[1:]))
+                batch = jax.tree_util.tree_map(relayout, batch)
         if self.curriculum_scheduler is not None:
             # truncate seq dim to the scheduled difficulty (reference
             # engine.py:1560 curriculum_seqlen injection).  The scheduled
@@ -1522,7 +1545,9 @@ class Engine:
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             extra = (jnp.float32(theta),)
         if self._param_offload is not None:
-            loss = self._param_offload.train_batch(batch)
+            with trace.span("train/fwd-bwd", step=self.global_steps,
+                            path="param-offload"):
+                loss = self._param_offload.train_batch(batch)
             self.global_steps += 1
             self.micro_steps += 1
             self.global_samples += self.train_batch_size
@@ -1532,9 +1557,13 @@ class Engine:
                          f"(param-offload={self.param_offload_device})",
                          ranks=[0])
             return loss
-        batch = self._shard_batch(batch)
+        with trace.span("train/load-batch", step=self.global_steps,
+                        phase="device-put"):
+            batch = self._shard_batch(batch)
         if self.offload_device != "none":
-            loss = self._host_offload_train_batch(batch)
+            with trace.span("train/fwd-bwd", step=self.global_steps,
+                            path="host-offload"):
+                loss = self._host_offload_train_batch(batch)
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
             self.global_samples += self.train_batch_size
@@ -1543,7 +1572,9 @@ class Engine:
                          f"(offload={self.offload_device})", ranks=[0])
             return loss
         self._tput.start()
-        self._state, metrics = self._compiled_train_step(self._state, batch, *extra)
+        with trace.span("train/fwd-bwd", step=self.global_steps):
+            self._state, metrics = self._compiled_train_step(
+                self._state, batch, *extra)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         self.global_samples += self.train_batch_size
@@ -1566,9 +1597,11 @@ class Engine:
     def forward(self, batch):
         """Record the micro-batch; loss returned lazily by backward's grad pass."""
         self._require_state()
-        self._fwd_batch = self._shard_batch(batch)
-        loss, grads = self._compiled_grad_step(
-            self._state, self._fwd_batch, jnp.int32(self.micro_steps))
+        with trace.span("train/load-batch", micro=self.micro_steps):
+            self._fwd_batch = self._shard_batch(batch)
+        with trace.span("train/fwd-bwd", micro=self.micro_steps):
+            loss, grads = self._compiled_grad_step(
+                self._state, self._fwd_batch, jnp.int32(self.micro_steps))
         self._pending = (loss, grads)
         return loss
 
@@ -1599,8 +1632,9 @@ class Engine:
         grads, loss_sum = self._grad_buffer
         self._grad_buffer = None
         gas = self.gradient_accumulation_steps
-        self._state, metrics = self._compiled_apply_step(
-            self._state, grads, loss_sum, jnp.float32(gas))
+        with trace.span("train/apply-step", step=self.global_steps):
+            self._state, metrics = self._compiled_apply_step(
+                self._state, grads, loss_sum, jnp.float32(gas))
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         self._maybe_print(metrics)
@@ -1620,6 +1654,12 @@ class Engine:
         loss = float(jax.device_get(metrics["loss"]))
         lr = float(jax.device_get(metrics["lr"]))
         gn = float(jax.device_get(metrics["grad_norm"]))
+        # registry surface rides the already-paid device fetch (same
+        # cadence as the log line / monitor events)
+        telemetry_registry.gauge("train_loss", "loss at last report").set(loss)
+        telemetry_registry.gauge("train_lr", "lr at last report").set(lr)
+        telemetry_registry.gauge(
+            "train_grad_norm", "grad norm at last report").set(gn)
         if want_print:
             log_dist(f"step={self.global_steps} loss={loss:.4f} lr={lr:.3e} "
                      f"grad_norm={gn:.3f}", ranks=[0])
@@ -1636,22 +1676,25 @@ class Engine:
 
     # checkpointing lives in runtime/checkpointing.py (wired in M3)
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
-        if self._param_offload is not None:
-            return self._param_offload.save_checkpoint(
-                save_dir, tag=tag, client_state=client_state)
-        from .checkpointing import save_checkpoint as _save
+        with trace.span("train/checkpoint", step=self.global_steps):
+            if self._param_offload is not None:
+                return self._param_offload.save_checkpoint(
+                    save_dir, tag=tag, client_state=client_state)
+            from .checkpointing import save_checkpoint as _save
 
-        self._require_state()
-        if not self._has_store_transform:
-            return _save(self, save_dir, tag=tag, client_state=client_state)
-        # checkpoints stay in canonical (global) layer order so any
-        # topology/schedule/placement can resume them
-        stored = self._state
-        self._state = self._transform_train_state(stored, to_stored=False)
-        try:
-            return _save(self, save_dir, tag=tag, client_state=client_state)
-        finally:
-            self._state = stored
+            self._require_state()
+            if not self._has_store_transform:
+                return _save(self, save_dir, tag=tag,
+                             client_state=client_state)
+            # checkpoints stay in canonical (global) layer order so any
+            # topology/schedule/placement can resume them
+            stored = self._state
+            self._state = self._transform_train_state(stored, to_stored=False)
+            try:
+                return _save(self, save_dir, tag=tag,
+                             client_state=client_state)
+            finally:
+                self._state = stored
 
     def load_checkpoint(self, load_dir, tag=None, strict: bool = True):
         if self._param_offload is not None:

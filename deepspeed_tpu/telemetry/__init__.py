@@ -1,0 +1,29 @@
+"""Unified telemetry layer: metrics registry + step tracer + recompile
+watchdog.
+
+Three coordinated surfaces replacing the reference's scattered
+``monitor/`` / ``utils/timer.py`` / profiler observability:
+
+- :mod:`.registry` — process-wide counters/gauges/histograms with JSON
+  (``snapshot()``) and Prometheus-text export; every subsystem
+  (``MonitorMaster`` events, ``ThroughputTimer``, serving latency,
+  heartbeats, the watchdog) publishes here.
+- :mod:`.trace` — host-side span tracing emitting Chrome-trace JSON
+  (Perfetto-viewable), wired into the train-engine phases, the serving
+  loop, and (via ``device_span``/HLO metadata) pipeline stage bodies.
+- :mod:`.recompile` — watchdog over jitted hot loops that counts
+  distinct compile signatures and warns when a warm loop recompiles.
+
+Launcher integration: ``dstpu --metrics_dir DIR`` injects
+``DSTPU_METRICS_DIR`` so every rank dumps ``metrics_rank<k>.json`` on
+exit; ``DSTPU_TRACE=/path.json`` auto-enables tracing and writes the
+trace on exit (use ``{rank}`` in the path for multi-rank runs).
+"""
+from . import recompile, trace  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry, counter, gauge, get_registry,
+    histogram, maybe_install_exit_dump,
+)
+
+# arm the per-rank exit dump when the launcher asked for one
+maybe_install_exit_dump()

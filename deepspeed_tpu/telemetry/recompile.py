@@ -1,0 +1,277 @@
+"""Recompilation watchdog: catch silent XLA recompiles in hot loops.
+
+The single most expensive silent failure mode on TPU: a shape or dtype
+that drifts between steps (a ragged final batch, a python float that
+became an array, a cache that grew) makes ``jax.jit`` trace + compile a
+NEW executable — seconds to minutes of stall that looks like "training
+got slow" with no error anywhere.  The reference has nothing comparable
+(CUDA eager mode doesn't recompile); on XLA it is the first thing to
+rule out.
+
+:func:`watch` wraps a jitted callable.  Each call computes a cheap
+host-side signature — the args pytree structure plus every leaf's
+(shape, dtype) — the shape/dtype part of the key ``jax.jit``'s C++
+cache dispatches on; the part it cannot see (shardings, layouts) is
+covered by a post-call ``_cache_size()`` cross-check: executable-count
+growth on an already-known signature is also flagged as a recompile.
+The FIRST distinct signature per watched site is the expected warm-up
+compile; every NEW signature after that means the hot loop recompiled:
+
+- ``xla_recompiles_total{site=...}`` increments (once per new signature);
+- a rate-limited warning names the site and the offending leaf shapes,
+  diffed against the previously seen signature when possible;
+- where the wrapped function exposes ``_cache_size()`` (jitted
+  callables do), the executable count is cross-checked into the log.
+
+Sites whose signatures legitimately vary (chunked prefill compiles one
+executable per power-of-two chunk BY DESIGN) pass ``warn=False``: their
+compile population lands in ``xla_compiled_signatures_total`` only, so
+``xla_recompiles_total`` stays a clean page-the-oncall alert metric.
+
+Disable globally with ``DSTPU_RECOMPILE_WATCHDOG=0`` (``watch`` then
+returns the callable unwrapped).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional
+
+from ..utils.logging import logger
+from . import registry as _registry
+
+__all__ = ["watch", "RecompileWatchdog", "total_recompiles", "WATCHDOG_ENV"]
+
+WATCHDOG_ENV = "DSTPU_RECOMPILE_WATCHDOG"
+
+_WARN_INTERVAL_S = 30.0
+
+
+def _leaf_sig(leaf: Any):
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype),
+                bool(getattr(leaf, "weak_type", False)))
+    # python scalars trace as weak-typed values: the VALUE does not key
+    # the jit cache, the python type does
+    return type(leaf).__name__
+
+
+def _tree_sig(tree):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef, tuple(_leaf_sig(l) for l in leaves))
+
+
+def _leaf_sigs_of(sig):
+    out = []
+    for part in sig:
+        if part is not None:
+            out.extend(part[1])
+    return out
+
+
+def _describe(sig) -> str:
+    shapes = [f"{s[0]}:{s[1]}" for s in _leaf_sigs_of(sig)
+              if isinstance(s, tuple)]
+    head = ", ".join(shapes[:8])
+    if len(shapes) > 8:
+        head += f", … +{len(shapes) - 8} more"
+    return head
+
+
+def _diff(old_sig, new_sig) -> Optional[str]:
+    """First differing leaf between two signatures with the same tree
+    structure — usually THE offending argument."""
+    if old_sig is None:
+        return None
+    old_parts = [p[0] for p in old_sig if p is not None]
+    new_parts = [p[0] for p in new_sig if p is not None]
+    if old_parts != new_parts:
+        return None
+    for i, (a, b) in enumerate(zip(_leaf_sigs_of(old_sig),
+                                   _leaf_sigs_of(new_sig))):
+        if a != b:
+            return f"leaf #{i}: {a} -> {b}"
+    return None
+
+
+class _Watched:
+    """Transparent wrapper: forwards ``__call__`` through the signature
+    check, everything else (``lower``, ``_cache_size`` …) to the wrapped
+    callable."""
+
+    __slots__ = ("_fn", "_name", "_warn", "_dog", "_sigs", "_last_sig",
+                 "_arg0_obj", "_arg0_sig", "_max_cache_size", "_settled")
+
+    def __init__(self, fn, name: str, warn: bool, dog: "RecompileWatchdog"):
+        self._fn = fn
+        self._name = name
+        self._warn = warn
+        self._dog = dog
+        self._sigs = set()
+        self._last_sig = None          # signature of the PREVIOUS call —
+        self._arg0_obj = None          # the loop that was actually running
+        self._arg0_sig = None
+        self._max_cache_size = None
+        self._settled = False          # saw >=1 call with NO cache growth
+
+    def _signature_of(self, args, kwargs):
+        # (head, rest) pair: the first positional arg signed separately
+        # with an identity memo — serving passes the same params tree
+        # every tick; skip re-flattening its hundreds of leaves
+        if args and args[0] is self._arg0_obj:
+            head = self._arg0_sig
+        elif args:
+            head = _tree_sig((args[0],))
+            self._arg0_obj = args[0]   # strong ref: pins the python tree
+            self._arg0_sig = head      # (donated buffers are already
+        else:                          # deleted; only wrappers persist)
+            head = None
+        return (head, _tree_sig((args[1:], kwargs)))
+
+    def __call__(self, *args, **kwargs):
+        try:
+            sig = self._signature_of(args, kwargs)
+        except Exception:
+            sig = None   # unhashable leaf etc.: never break the hot path
+        is_new = sig is not None and sig not in self._sigs
+        if is_new:
+            first = not self._sigs
+            self._sigs.add(sig)
+            self._dog._on_new_signature(self, sig, self._last_sig, first)
+        self._last_sig = sig
+        out = self._fn(*args, **kwargs)
+        # cross-check: jax.jit's C++ cache also keys on SHARDINGS and
+        # layouts, which the host-side signature cannot see — if the
+        # executable count grew on an already-known signature, the loop
+        # recompiled anyway (e.g. a resharded state after checkpoint load)
+        try:
+            cs = self._fn._cache_size()
+        except Exception:
+            cs = None
+        if cs is not None:
+            if self._max_cache_size is not None and cs > self._max_cache_size:
+                # growth counts only once the site has SETTLED (seen a
+                # call with no growth): the warm-up phase legitimately
+                # compiles per-layout variants as eager-built buffers are
+                # replaced by committed jit outputs
+                if self._settled and not is_new and sig is not None:
+                    self._dog._on_hidden_recompile(self, cs)
+            elif self._max_cache_size is not None:
+                self._settled = True
+            if self._max_cache_size is None or cs > self._max_cache_size:
+                self._max_cache_size = cs
+        return out
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
+
+    @property
+    def signatures_seen(self) -> int:
+        return len(self._sigs)
+
+
+class RecompileWatchdog:
+    def __init__(self, registry: Optional[_registry.Registry] = None,
+                 warn_interval_s: float = _WARN_INTERVAL_S):
+        self._registry = registry or _registry.get_registry()
+        self._warn_interval_s = warn_interval_s
+        self._last_warn: dict = {}
+        self._recompiles = self._registry.counter(
+            "xla_recompiles_total",
+            "post-warm-up distinct jit signatures per watched site",
+            labelnames=("site",))
+        self._compiles = self._registry.counter(
+            "xla_compiled_signatures_total",
+            "all distinct jit signatures per watched site (warm-up "
+            "included)", labelnames=("site",))
+
+    def enabled(self) -> bool:
+        return os.environ.get(WATCHDOG_ENV, "1") != "0"
+
+    def watch(self, fn, name: str, warn: bool = True):
+        """Wrap ``fn``; returns ``fn`` unchanged when the watchdog is
+        disabled.  ``warn=False`` counts signatures without warning
+        (for sites whose shapes vary by design)."""
+        if not self.enabled():
+            return fn
+        return _Watched(fn, name, warn, self)
+
+    def _on_new_signature(self, watched: _Watched, sig, prev_call_sig,
+                          first: bool):
+        self._compiles.labels(site=watched._name).inc()
+        if first or not watched._warn:
+            # warn=False sites vary by design: their compile population
+            # stays out of the alert counter, which must mean "a hot loop
+            # recompiled unexpectedly" and nothing else
+            return
+        self._recompiles.labels(site=watched._name).inc()
+        if not self._should_warn(watched._name):
+            return
+        # diff against the PREVIOUS CALL's signature — the loop that was
+        # actually running — not the last novel one
+        diff = _diff(prev_call_sig, sig)
+        cache_size = ""
+        try:
+            cs = watched._fn._cache_size()
+            cache_size = f"; jit cache held {cs} executable(s) before this call"
+        except Exception:
+            pass
+        detail = diff if diff is not None else \
+            f"arg shapes now [{_describe(sig)}]"
+        logger.warning(
+            f"XLA RECOMPILE in hot loop {watched._name!r}: signature "
+            f"#{len(watched._sigs)} after warm-up ({detail}){cache_size}. "
+            f"Each recompile stalls the loop for the full compile time — "
+            f"check for drifting batch/cache shapes or dtype flips.")
+
+    def _on_hidden_recompile(self, watched: _Watched, cache_size: int):
+        """Executable count grew on an already-known arg signature: the
+        jit cache keys on shardings/layouts too, so the loop recompiled
+        for a reason the shape signature cannot show."""
+        self._compiles.labels(site=watched._name).inc()
+        if not watched._warn:
+            # by-design-varying sites (per-width placement etc.) hit this
+            # legitimately — e.g. an uncommitted initial buffer becoming a
+            # committed jit output; keep them out of the alert counter
+            return
+        self._recompiles.labels(site=watched._name).inc()
+        if not self._should_warn(watched._name):
+            return
+        logger.warning(
+            f"XLA RECOMPILE in hot loop {watched._name!r}: executable "
+            f"count grew to {cache_size} with UNCHANGED arg shapes/dtypes "
+            f"— the jit cache also keys on shardings and layouts; check "
+            f"for a resharded params/state tree (e.g. after checkpoint "
+            f"load or a mesh change).")
+
+    def _should_warn(self, site: str) -> bool:
+        now = time.monotonic()
+        if now - self._last_warn.get(site, -1e18) < self._warn_interval_s:
+            return False
+        self._last_warn[site] = now
+        return True
+
+
+_default_watchdog: Optional[RecompileWatchdog] = None
+
+
+def _get_default() -> RecompileWatchdog:
+    global _default_watchdog
+    if _default_watchdog is None:
+        _default_watchdog = RecompileWatchdog()
+    return _default_watchdog
+
+
+def watch(fn, name: str, warn: bool = True):
+    """Module-level convenience over the default watchdog."""
+    return _get_default().watch(fn, name, warn=warn)
+
+
+def total_recompiles() -> float:
+    """Sum of ``xla_recompiles_total`` across sites (0.0 when nothing
+    recompiled or the watchdog never armed)."""
+    return _get_default()._recompiles.total()

@@ -1,0 +1,420 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The reference scatters observability across ``monitor/`` (event fan-out
+to TensorBoard/W&B/CSV), ``utils/timer.py`` (log-line throughput) and the
+FLOPs profiler — each with private state and no export surface.  This
+module is the shared substrate: every subsystem publishes named metrics
+into ONE registry, which renders to JSON (``snapshot()``) and to the
+Prometheus text exposition format (``render_prometheus()``), so a
+production deployment scrapes a single endpoint / reads a single per-rank
+dump file instead of tailing logs.
+
+Design notes
+- Metric handles are get-or-create and idempotent: calling
+  ``registry.counter("x")`` twice returns the same object; re-registering
+  a name with a different type/labelset raises (a silent re-type would
+  corrupt downstream dashboards).
+- All mutation is lock-protected but O(dict lookup + float add): cheap
+  enough for per-train-step / per-decode-tick increments.
+- Histograms are fixed-bucket (Prometheus semantics: cumulative
+  ``le``-bucket counts + ``_sum`` + ``_count``); no quantile sketching,
+  so merging across ranks is exact addition.
+- Per-rank export on exit: the launcher injects ``DSTPU_METRICS_DIR``;
+  :func:`maybe_install_exit_dump` (called on ``telemetry`` import)
+  registers an ``atexit`` writer of ``metrics_rank<k>.json`` there.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "get_registry", "counter", "gauge", "histogram",
+    "maybe_install_exit_dump", "METRICS_DIR_ENV",
+]
+
+METRICS_DIR_ENV = "DSTPU_METRICS_DIR"
+
+# Prometheus default buckets skew web-request-sized; these cover both
+# decode ticks (sub-ms) and train steps / checkpoint writes (minutes).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Child:
+    """One labelset's value cell."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _CounterChild(_Child):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self._value += amount
+
+
+class _GaugeChild(_Child):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.Lock, buckets: Tuple[float, ...]):
+        self._lock = lock
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)   # +1 for the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        if value != value:          # NaN observations poison sum/percentiles
+            return
+        with self._lock:
+            # non-cumulative per-bucket counts internally; rendered
+            # cumulatively (Prometheus ``le`` semantics) on export
+            i = 0
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    break
+            else:
+                i = len(self.buckets)
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def cumulative(self) -> Iterable[Tuple[float, int]]:
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            yield b, acc
+        yield float("inf"), acc + self.counts[-1]
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...],
+                 lock: threading.Lock, **kwargs):
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._lock = lock
+        self._kwargs = kwargs
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            try:
+                values = tuple(str(kv[n]) for n in self.labelnames)
+            except KeyError as e:
+                raise ValueError(
+                    f"metric {self.name} labels are {self.labelnames}") from e
+            if len(kv) != len(self.labelnames):
+                raise ValueError(
+                    f"metric {self.name} labels are {self.labelnames}, "
+                    f"got {sorted(kv)}")
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes {len(self.labelnames)} label "
+                f"value(s) {self.labelnames}, got {len(values)}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._make_child()
+        return child
+
+    def _default_child(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name} has labels {self.labelnames}; call "
+                f".labels(...) first")
+        return self.labels()
+
+    def samples(self):
+        with self._lock:
+            items = list(self._children.items())
+        return items
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+    def total(self) -> float:
+        """Sum over every labelset (convenience for tests/assertions)."""
+        return sum(c.value for _, c in self.samples())
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def _make_child(self):
+        return _HistogramChild(self._lock, self._kwargs["buckets"])
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+
+class Registry:
+    """Named metric store with JSON + Prometheus export."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    # -- get-or-create handles ----------------------------------------
+    def _get(self, cls, name: str, help: str,
+             labelnames: Sequence[str], **kwargs) -> _Metric:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, labelnames,
+                                              self._lock, **kwargs)
+                return m
+        if not isinstance(m, cls):
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        if m.labelnames != labelnames and labelnames:
+            raise ValueError(
+                f"metric {name!r} already registered with labels "
+                f"{m.labelnames}, requested {labelnames}")
+        if kwargs and m._kwargs != kwargs:
+            # e.g. histogram buckets: observations landing in a different
+            # bucket layout than the caller expects would silently corrupt
+            # downstream dashboards — same failure class as a re-type
+            raise ValueError(
+                f"metric {name!r} already registered with "
+                f"{m._kwargs}, requested {kwargs}")
+        return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, labelnames,
+                         buckets=tuple(sorted(buckets)))
+
+    # -- export --------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-able view of every metric: counters/gauges as plain
+        values, histograms as cumulative ``le``-bucket maps + sum/count."""
+        out: dict = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            entry: dict = {"type": m.kind, "help": m.help,
+                           "labelnames": list(m.labelnames), "samples": []}
+            for labelvalues, child in m.samples():
+                labels = dict(zip(m.labelnames, labelvalues))
+                if m.kind == "histogram":
+                    entry["samples"].append({
+                        "labels": labels,
+                        "buckets": {_fmt_value(le): c
+                                    for le, c in child.cumulative()},
+                        "sum": child.sum, "count": child.count})
+                else:
+                    entry["samples"].append(
+                        {"labels": labels, "value": child.value})
+            out[m.name] = entry
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (v0.0.4)."""
+        lines = []
+        for name, entry in self.snapshot().items():
+            if entry["help"]:
+                lines.append(f"# HELP {name} {entry['help']}")
+            lines.append(f"# TYPE {name} {entry['type']}")
+            for s in entry["samples"]:
+                base_labels = ",".join(
+                    f'{k}="{_escape_label_value(v)}"'
+                    for k, v in s["labels"].items())
+                if entry["type"] == "histogram":
+                    for le, c in s["buckets"].items():
+                        ls = (base_labels + "," if base_labels else "") \
+                            + f'le="{le}"'
+                        lines.append(f"{name}_bucket{{{ls}}} {c}")
+                    suffix = f"{{{base_labels}}}" if base_labels else ""
+                    lines.append(
+                        f"{name}_sum{suffix} {_fmt_value(s['sum'])}")
+                    lines.append(f"{name}_count{suffix} {s['count']}")
+                else:
+                    suffix = f"{{{base_labels}}}" if base_labels else ""
+                    lines.append(
+                        f"{name}{suffix} {_fmt_value(s['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump(self, path: str) -> None:
+        """Write ``snapshot()`` as JSON (atomic rename)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.snapshot(), fh, indent=1)
+        os.replace(tmp, path)
+
+    def clear(self) -> None:
+        """Drop every metric (test isolation helper)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_default_registry = Registry()
+
+
+def get_registry() -> Registry:
+    return _default_registry
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> Counter:
+    return _default_registry.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "",
+          labelnames: Sequence[str] = ()) -> Gauge:
+    return _default_registry.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return _default_registry.histogram(name, help, labelnames, buckets)
+
+
+def _rank() -> int:
+    # launcher-injected rank first (set before jax initializes); fall back
+    # to jax.process_index() only if jax is already imported (never force
+    # the import from an atexit path)
+    env = os.environ.get("DSTPU_PROCESS_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:
+            pass
+    return 0
+
+
+_exit_dump_installed: Optional[str] = None
+
+
+def maybe_install_exit_dump(directory: Optional[str] = None) -> Optional[str]:
+    """Register an ``atexit`` dump of the default registry to
+    ``<dir>/metrics_rank<k>.json``.  ``directory`` defaults to the
+    ``DSTPU_METRICS_DIR`` env var (injected by the launcher); no-op when
+    neither is set.  Returns the target directory (or None).
+
+    The rank — and so the file name — resolves at DUMP time, not here:
+    this usually runs at ``import deepspeed_tpu``, before jax has
+    initialized, and a launcher-less multi-host job would otherwise bake
+    rank 0 into every host and have them clobber one file."""
+    global _exit_dump_installed
+    directory = directory or os.environ.get(METRICS_DIR_ENV)
+    if not directory:
+        return None
+    if _exit_dump_installed == directory:
+        return directory
+    _exit_dump_installed = directory
+
+    def _dump():
+        try:
+            _default_registry.dump(
+                os.path.join(directory, f"metrics_rank{_rank()}.json"))
+        except Exception:
+            pass   # never let a metrics dump break interpreter shutdown
+
+    atexit.register(_dump)
+    return directory

@@ -1,0 +1,204 @@
+"""Host-side step tracing in Chrome trace format (Perfetto-viewable).
+
+``with trace.span("fwd-bwd"):`` records a complete ("X") event with
+microsecond timestamps; the resulting JSON loads in ``ui.perfetto.dev``
+or ``chrome://tracing`` and nests spans by containment, giving a
+per-step timeline of the HOST side of training/serving — load-batch,
+dispatch, device fetch, admission, prefill, decode ticks — the half of
+the story ``jax.profiler`` device traces don't show.
+
+Off by default and near-free when off: ``span.__enter__`` is one
+attribute read.  Enable programmatically (:func:`enable`) or by setting
+``DSTPU_TRACE=/path/to/trace.json`` — the file is written on interpreter
+exit (and on :func:`save`).
+
+Two bridges to device-side profiling:
+- ``DSTPU_TRACE_JAX=1`` additionally wraps every span in a
+  ``jax.profiler.TraceAnnotation``, so spans appear on the host track of
+  a ``jax.profiler.trace()`` capture alongside device ops.
+- :func:`device_span` returns a ``jax.named_scope`` usable INSIDE traced
+  code (pipeline stage bodies): names land in HLO metadata and XLA
+  profiles, where host spans cannot reach.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["span", "device_span", "enable", "disable", "enabled", "clear",
+           "save", "to_json", "TRACE_ENV", "TRACE_JAX_ENV"]
+
+TRACE_ENV = "DSTPU_TRACE"
+TRACE_JAX_ENV = "DSTPU_TRACE_JAX"
+
+_MAX_EVENTS = 500_000    # hard cap: a forgotten enable() must not OOM the host
+
+
+class _Tracer:
+    def __init__(self):
+        self.enabled = False
+        self.jax_bridge = False
+        self.events: list = []
+        self.dropped = 0
+        self.lock = threading.Lock()
+        self.pid = os.getpid()
+        # perf_counter has no defined epoch; one process-wide origin keeps
+        # every thread's timestamps on a shared, roughly-unix-μs axis
+        self.t0_ns = time.perf_counter_ns()
+
+    def now_us(self) -> float:
+        return (time.perf_counter_ns() - self.t0_ns) / 1e3
+
+
+_tracer = _Tracer()
+
+
+class span:
+    """Context manager / decorator recording one complete trace event.
+
+    ``args`` (small JSON-ables only) land in the event's ``args`` dict —
+    visible in the Perfetto detail pane."""
+
+    __slots__ = ("name", "args", "_t0", "_jax_ctx")
+
+    def __init__(self, name: str, **args):
+        self.name = name
+        self.args = args or None
+        self._t0 = None
+        self._jax_ctx = None
+
+    def __enter__(self):
+        if not _tracer.enabled:
+            return self
+        if _tracer.jax_bridge:
+            try:
+                import jax.profiler
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        self._t0 = _tracer.now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is None:
+            return False
+        t1 = _tracer.now_us()
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+            self._jax_ctx = None
+        ev = {"name": self.name, "ph": "X", "ts": self._t0,
+              "dur": t1 - self._t0, "pid": _tracer.pid,
+              "tid": threading.get_ident()}
+        if self.args:
+            ev["args"] = self.args
+        with _tracer.lock:
+            if len(_tracer.events) < _MAX_EVENTS:
+                _tracer.events.append(ev)
+            else:
+                _tracer.dropped += 1
+        self._t0 = None
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with span(self.name, **(self.args or {})):
+                return fn(*a, **kw)
+
+        return wrapped
+
+
+def device_span(name: str):
+    """``jax.named_scope`` for use INSIDE jitted/traced code (host spans
+    measure nothing there — tracing runs once).  The name lands in HLO op
+    metadata, so XLA profiles and compiler dumps attribute work to it.
+    Falls back to a no-op when jax is unavailable."""
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except Exception:
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def enable(jax_bridge: Optional[bool] = None) -> None:
+    """Start recording spans.  ``jax_bridge=True`` mirrors every span
+    into ``jax.profiler.TraceAnnotation`` (defaults to the
+    ``DSTPU_TRACE_JAX`` env var)."""
+    if jax_bridge is None:
+        jax_bridge = os.environ.get(TRACE_JAX_ENV, "") not in ("", "0")
+    _tracer.jax_bridge = bool(jax_bridge)
+    _tracer.enabled = True
+
+
+def disable() -> None:
+    _tracer.enabled = False
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def clear() -> None:
+    with _tracer.lock:
+        _tracer.events.clear()
+        _tracer.dropped = 0
+
+
+def to_json() -> dict:
+    """Chrome-trace JSON object (the ``traceEvents`` wrapper form)."""
+    with _tracer.lock:
+        events = list(_tracer.events)
+        dropped = _tracer.dropped
+    meta = {"displayTimeUnit": "ms", "traceEvents": events}
+    if dropped:
+        meta["dstpu_dropped_events"] = dropped
+    return meta
+
+
+def save(path: str) -> str:
+    """Write the trace JSON to ``path`` (atomic rename); returns the
+    path.  Loadable with ``json.load`` and in Perfetto as-is."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(to_json(), fh)
+    os.replace(tmp, path)
+    return path
+
+
+def _maybe_autostart() -> None:
+    path = os.environ.get(TRACE_ENV)
+    if not path:
+        return
+    enable()
+
+    def _dump():
+        try:
+            p = path
+            if "{rank}" in p:
+                # multi-rank launches: one trace file per worker
+                p = p.format(rank=os.environ.get("DSTPU_PROCESS_ID", "0"))
+            save(p)
+        except Exception:
+            pass
+
+    atexit.register(_dump)
+
+
+_maybe_autostart()

@@ -32,4 +32,11 @@ def beat(min_interval_s: float = 1.0) -> bool:
     _last_beat = now
     with open(path, "w") as fh:
         fh.write(str(time.time()))
+    try:
+        from ..telemetry import registry as _reg
+
+        _reg.counter("heartbeat_beats_total",
+                     "heartbeat file touches (launcher liveness)").inc()
+    except Exception:
+        pass   # the failure detector must never depend on telemetry
     return True

@@ -122,7 +122,7 @@ class ThroughputTimer:
     """Samples/sec + tokens/sec reporting (reference ``utils/timer.py:135``)."""
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
-                 monitor_memory: bool = False):
+                 monitor_memory: bool = False, metric_prefix: str = "train"):
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
@@ -132,6 +132,21 @@ class ThroughputTimer:
         self.step_elapsed_time = 0.0
         self.started = False
         self.start_time = 0.0
+        # telemetry-registry surface (telemetry/registry.py): a steps
+        # counter per stop (dict lookup + add), throughput gauges at
+        # report boundaries only (same cadence as the log line)
+        from ..telemetry import registry as _reg
+
+        self._m_steps = _reg.counter(
+            f"{metric_prefix}_steps_total", "optimizer steps completed")
+        self._m_samples = _reg.counter(
+            f"{metric_prefix}_samples_total", "samples consumed")
+        self._m_sps = _reg.gauge(
+            f"{metric_prefix}_samples_per_sec",
+            f"throughput over the last {steps_per_output}-step window")
+        self._m_ms = _reg.gauge(
+            f"{metric_prefix}_ms_per_step",
+            f"mean step wall-time over the last window (ms)")
 
     def start(self) -> None:
         self.started = True
@@ -148,15 +163,21 @@ class ThroughputTimer:
         duration = time.perf_counter() - self.start_time
         if global_step:
             self.global_step_count += 1
+            self._m_steps.inc()
+            self._m_samples.inc(self.batch_size)
         if self.global_step_count > self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             if report_speed and self.global_step_count % self.steps_per_output == 0:
                 steps = self.steps_per_output
+                sps = self.batch_size * steps / max(self.step_elapsed_time, 1e-9)
+                ms = 1000.0 * self.step_elapsed_time / steps
+                self._m_sps.set(sps)
+                self._m_ms.set(ms)
                 logger.info(
                     f"step={self.global_step_count}, "
-                    f"samples/sec={self.batch_size * steps / max(self.step_elapsed_time, 1e-9):.2f}, "
-                    f"ms/step={1000.0 * self.step_elapsed_time / steps:.2f}"
+                    f"samples/sec={sps:.2f}, "
+                    f"ms/step={ms:.2f}"
                 )
                 self.step_elapsed_time = 0.0
 

@@ -1,0 +1,449 @@
+"""Unified telemetry layer: registry counter/gauge/histogram semantics,
+Prometheus text rendering, Chrome-trace JSON validity, and the XLA
+recompilation watchdog (fires exactly once per forced shape change,
+stays silent on a stable hot loop)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.telemetry import recompile, trace
+from deepspeed_tpu.telemetry.registry import Registry, get_registry
+
+
+@pytest.fixture(autouse=True)
+def clean_trace():
+    trace.disable()
+    trace.clear()
+    yield
+    trace.disable()
+    trace.clear()
+
+
+# ----------------------------------------------------------------------
+# registry semantics
+# ----------------------------------------------------------------------
+def test_counter_semantics():
+    r = Registry()
+    c = r.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # get-or-create returns the same handle
+    assert r.counter("reqs_total") is c
+    # re-registering under another type is an error
+    with pytest.raises(ValueError):
+        r.gauge("reqs_total")
+
+
+def test_gauge_semantics():
+    r = Registry()
+    g = r.gauge("depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5.0
+
+
+def test_labels():
+    r = Registry()
+    c = r.counter("hits_total", labelnames=("site",))
+    c.labels(site="a").inc()
+    c.labels(site="a").inc()
+    c.labels(site="b").inc()
+    assert c.labels(site="a").value == 2.0
+    assert c.total() == 3.0
+    with pytest.raises(ValueError):
+        c.inc()              # labelled metric needs .labels(...)
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+
+
+def test_histogram_semantics():
+    r = Registry()
+    h = r.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    h.observe(float("nan"))     # dropped, must not poison sum/count
+    child = h._default_child()
+    assert child.count == 4
+    assert child.sum == pytest.approx(55.55)
+    cum = dict(child.cumulative())
+    assert cum[0.1] == 1 and cum[1.0] == 2 and cum[10.0] == 3
+    assert cum[float("inf")] == 4
+
+
+def test_snapshot_json_roundtrip():
+    r = Registry()
+    r.counter("a_total").inc(2)
+    r.gauge("b").set(1.5)
+    r.histogram("c_seconds", buckets=(1.0,)).observe(0.5)
+    snap = r.snapshot()
+    assert json.loads(json.dumps(snap)) == snap
+    assert snap["a_total"]["samples"][0]["value"] == 2
+    assert snap["c_seconds"]["samples"][0]["count"] == 1
+
+
+def _parse_prometheus(text):
+    """Tiny exposition-format parser: {(name, labelstring): value}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        metric, value = line.rsplit(" ", 1)
+        out[metric] = float(value)
+    return out
+
+
+def test_prometheus_render_roundtrip():
+    """Registry snapshot values survive the Prometheus text renderer."""
+    r = Registry()
+    c = r.counter("req_total", "reqs", labelnames=("site",))
+    c.labels(site="train").inc(3)
+    c.labels(site='we"ird\nsite').inc()     # label escaping
+    r.gauge("depth").set(2.5)
+    h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = r.render_prometheus()
+    parsed = _parse_prometheus(text)
+    assert parsed['req_total{site="train"}'] == 3
+    assert parsed["depth"] == 2.5
+    assert parsed['lat_seconds_bucket{le="0.1"}'] == 1
+    assert parsed['lat_seconds_bucket{le="1"}'] == 2
+    assert parsed['lat_seconds_bucket{le="+Inf"}'] == 2
+    assert parsed["lat_seconds_count"] == 2
+    assert parsed["lat_seconds_sum"] == pytest.approx(0.55)
+    # every snapshot scalar appears in the rendering
+    snap = r.snapshot()
+    for name, entry in snap.items():
+        if entry["type"] != "histogram":
+            for s in entry["samples"]:
+                assert any(m.startswith(name) for m in parsed), name
+
+
+def test_histogram_bucket_conflict_raises():
+    r = Registry()
+    r.histogram("lat_seconds", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError):
+        r.histogram("lat_seconds", buckets=(0.5, 5.0))
+    # same buckets: same handle
+    assert r.histogram("lat_seconds", buckets=(0.1, 1.0)) is not None
+
+
+def test_registry_dump(tmp_path):
+    r = Registry()
+    r.counter("x_total").inc()
+    path = str(tmp_path / "m" / "metrics.json")
+    r.dump(path)
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["x_total"]["samples"][0]["value"] == 1
+
+
+# ----------------------------------------------------------------------
+# Chrome-trace step tracer
+# ----------------------------------------------------------------------
+def test_trace_disabled_records_nothing():
+    with trace.span("ghost"):
+        pass
+    assert trace.to_json()["traceEvents"] == []
+
+
+def test_trace_span_nesting_and_save(tmp_path):
+    trace.enable()
+    with trace.span("step", idx=0):
+        with trace.span("fwd"):
+            pass
+        with trace.span("bwd"):
+            pass
+    trace.disable()
+    path = str(tmp_path / "trace.json")
+    trace.save(path)
+    with open(path) as fh:
+        data = json.load(fh)          # must be valid JSON
+    events = data["traceEvents"]
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"step", "fwd", "bwd"}
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0
+    step, fwd, bwd = by_name["step"], by_name["fwd"], by_name["bwd"]
+    # children nest inside the parent interval, in order
+    assert step["ts"] <= fwd["ts"]
+    assert fwd["ts"] + fwd["dur"] <= bwd["ts"]
+    assert bwd["ts"] + bwd["dur"] <= step["ts"] + step["dur"]
+    assert by_name["step"]["args"] == {"idx": 0}
+
+
+def test_trace_decorator():
+    trace.enable()
+
+    @trace.span("decorated")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert [e["name"] for e in trace.to_json()["traceEvents"]] == ["decorated"]
+
+
+# ----------------------------------------------------------------------
+# recompilation watchdog
+# ----------------------------------------------------------------------
+def _site_value(registry, metric, site):
+    c = registry.counter(metric, labelnames=("site",))
+    return c.labels(site=site).value
+
+
+def test_watchdog_counts_forced_shape_change_exactly_once():
+    reg = Registry()
+    dog = recompile.RecompileWatchdog(registry=reg)
+    f = dog.watch(jax.jit(lambda x: x + 1), "unit.step")
+    f(jnp.zeros((4,), jnp.float32))          # warm-up compile
+    assert _site_value(reg, "xla_recompiles_total", "unit.step") == 0
+    f(jnp.zeros((8,), jnp.float32))          # forced shape change
+    assert _site_value(reg, "xla_recompiles_total", "unit.step") == 1
+    f(jnp.zeros((8,), jnp.float32))          # now-known signature
+    f(jnp.zeros((4,), jnp.float32))
+    assert _site_value(reg, "xla_recompiles_total", "unit.step") == 1
+
+
+def test_watchdog_counts_dtype_change():
+    reg = Registry()
+    dog = recompile.RecompileWatchdog(registry=reg)
+    f = dog.watch(jax.jit(lambda x: x + 1), "unit.dtype")
+    f(jnp.zeros((4,), jnp.float32))
+    f(jnp.zeros((4,), jnp.int32))
+    assert _site_value(reg, "xla_recompiles_total", "unit.dtype") == 1
+
+
+def test_watchdog_silent_on_stable_loop():
+    reg = Registry()
+    dog = recompile.RecompileWatchdog(registry=reg)
+    f = dog.watch(jax.jit(lambda x, y: x * y), "unit.stable")
+    for i in range(10):
+        f(jnp.full((4,), float(i)), jnp.float32(i))
+    assert _site_value(reg, "xla_recompiles_total", "unit.stable") == 0
+    assert _site_value(reg, "xla_compiled_signatures_total",
+                       "unit.stable") == 1
+    assert dog._last_warn == {}       # no warning ever rate-limited in
+
+
+def test_watchdog_warn_false_counts_compiles_only():
+    reg = Registry()
+    dog = recompile.RecompileWatchdog(registry=reg)
+    f = dog.watch(jax.jit(lambda x: x + 1), "unit.varying", warn=False)
+    f(jnp.zeros((2,)))
+    f(jnp.zeros((4,)))
+    f(jnp.zeros((8,)))
+    assert _site_value(reg, "xla_compiled_signatures_total",
+                       "unit.varying") == 3
+    assert _site_value(reg, "xla_recompiles_total", "unit.varying") == 0
+
+
+def test_watchdog_wrapper_is_transparent():
+    f = jax.jit(lambda x: x * 2)
+    w = recompile.watch(f, "unit.transparent")
+    assert float(w(jnp.float32(3))) == 6.0
+    assert w.lower(jnp.float32(1)) is not None     # attr passthrough
+
+
+def test_watchdog_cache_size_cross_check():
+    """Executable-count growth with UNCHANGED arg shapes (the
+    sharding/layout-keyed recompile class the host signature cannot see)
+    is counted via the post-call ``_cache_size`` cross-check."""
+    reg = Registry()
+    dog = recompile.RecompileWatchdog(registry=reg)
+
+    class Stub:
+        cs = 1
+
+        def __call__(self, x):
+            return x
+
+        def _cache_size(self):
+            return self.cs
+
+    stub = Stub()
+    f = dog.watch(stub, "unit.hidden")
+    f(jnp.zeros((4,)))                     # warm-up: baseline cs=1
+    f(jnp.zeros((4,)))                     # stable call → site settles
+    assert _site_value(reg, "xla_recompiles_total", "unit.hidden") == 0
+    stub.cs = 2
+    f(jnp.zeros((4,)))                     # same signature, cache grew
+    assert _site_value(reg, "xla_recompiles_total", "unit.hidden") == 1
+    f(jnp.zeros((4,)))                     # stable again
+    assert _site_value(reg, "xla_recompiles_total", "unit.hidden") == 1
+    # pre-settle growth (warm-up layout churn) is never counted
+    dog2 = recompile.RecompileWatchdog(registry=reg)
+    stub2 = Stub()
+    g = dog2.watch(stub2, "unit.warmup")
+    stub2.cs = 1
+    g(jnp.zeros((4,)))
+    stub2.cs = 2
+    g(jnp.zeros((4,)))                     # growth before any stable call
+    assert _site_value(reg, "xla_recompiles_total", "unit.warmup") == 0
+
+
+def test_watchdog_env_disable(monkeypatch):
+    monkeypatch.setenv(recompile.WATCHDOG_ENV, "0")
+    f = jax.jit(lambda x: x)
+    assert recompile.watch(f, "unit.disabled") is f
+
+
+# ----------------------------------------------------------------------
+# integrations: monitor sink, throughput timer
+# ----------------------------------------------------------------------
+def test_monitor_registry_sink():
+    from deepspeed_tpu.monitor.monitor import MonitorConfig, MonitorMaster
+
+    m = MonitorMaster(MonitorConfig())
+    assert not m.enabled            # no external writer configured …
+    m.write_events([("Telemetry/test_sink", 2.25, 40)])
+    reg = get_registry()
+    g = reg.gauge("monitor_event", labelnames=("label",))
+    assert g.labels(label="Telemetry/test_sink").value == 2.25
+    gs = reg.gauge("monitor_event_samples", labelnames=("label",))
+    assert gs.labels(label="Telemetry/test_sink").value == 40
+
+
+def test_throughput_timer_publishes():
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+
+    t = ThroughputTimer(batch_size=4, start_step=0, steps_per_output=2,
+                        metric_prefix="ttimer_test")
+    for _ in range(4):
+        t.start()
+        t.stop()
+    reg = get_registry()
+    assert reg.counter("ttimer_test_steps_total").value == 4
+    assert reg.counter("ttimer_test_samples_total").value == 16
+    assert reg.gauge("ttimer_test_samples_per_sec").value > 0
+
+
+# ----------------------------------------------------------------------
+# end-to-end smoke: train + serve emit a valid trace and a non-empty
+# registry snapshot (the acceptance-criteria run)
+# ----------------------------------------------------------------------
+def test_train_serve_smoke_emits_trace_and_metrics(tmp_path):
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.set_mesh(None)
+    try:
+        trace.enable()
+        # -- train: 2 steps on the tiny MSE model ----------------------
+        import deepspeed_tpu
+        from .simple_model import SimpleModel
+
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+        engine.init_params()
+        rng = np.random.default_rng(0)
+        b = engine.train_batch_size
+        for i in range(2):
+            x = rng.normal(size=(b, 16)).astype(np.float32)
+            engine.train_batch({"x": x, "y": 0.1 * x})
+
+        # -- serve: 2 requests through the continuous batcher ----------
+        mesh_mod.set_mesh(None)
+        from deepspeed_tpu.inference.serving import ContinuousBatcher
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+        cfg = gpt2_config("gpt2-tiny", dtype=jnp.float32)
+        model = GPT2LMHeadModel(cfg)
+        params = jax.tree_util.tree_map(
+            lambda x: getattr(x, "value", x),
+            model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32))["params"],
+            is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        eng = deepspeed_tpu.init_inference(
+            model=model, mp_size=1, dtype=jnp.float32, params=params)
+        batcher = ContinuousBatcher(eng, n_slots=2)
+        prompts = [rng.integers(0, 512, size=(5,)).astype(np.int32)
+                   for _ in range(2)]
+        outs = batcher.run(prompts, ticks=4, max_new_tokens=4)
+        assert all(len(o) == 9 for o in outs)
+
+        trace.disable()
+        path = trace.save(str(tmp_path / "trace.json"))
+        with open(path) as fh:
+            data = json.load(fh)
+        names = {e["name"] for e in data["traceEvents"]}
+        assert len(names) >= 3, names
+        assert {"train/fwd-bwd", "serve/prefill",
+                "serve/decode-tick"} <= names
+
+        snap = get_registry().snapshot()
+        assert snap, "registry snapshot empty after train+serve"
+        assert snap["train_steps_total"]["samples"][0]["value"] >= 2
+        assert snap["serving_requests_completed_total"][
+            "samples"][0]["value"] >= 2
+        # the steady loops did not recompile after warm-up
+        rec = [s for s in snap["xla_recompiles_total"]["samples"]
+               if s["value"] > 0]
+        assert rec == [], rec
+        # and the snapshot renders to Prometheus text cleanly
+        text = get_registry().render_prometheus()
+        assert "train_steps_total" in text
+    finally:
+        mesh_mod.set_mesh(None)
+
+
+def test_serving_parked_batch_shrinks_to_single_row():
+    """Once a parked prefill batch is down to one pending row, the B-row
+    cache reference is dropped (the row is sliced into its own 1-row
+    cache) — and the emitted tokens are unchanged."""
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.set_mesh(None)
+    try:
+        import deepspeed_tpu
+        from deepspeed_tpu.inference.serving import ContinuousBatcher
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
+
+        cfg = gpt2_config("gpt2-tiny", dtype=jnp.float32)
+        model = GPT2LMHeadModel(cfg)
+        params = jax.tree_util.tree_map(
+            lambda x: getattr(x, "value", x),
+            model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32))["params"],
+            is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+        eng = deepspeed_tpu.init_inference(
+            model=model, mp_size=1, dtype=jnp.float32, params=params)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 512, size=(6,)).astype(np.int32)
+                   for _ in range(4)]
+
+        b = ContinuousBatcher(eng, n_slots=1, prefill_ahead=4)
+        # occupy the only slot, then park 3 equal-length prompts in ONE
+        # batched prefill
+        uids = [b.submit(prompts[0], max_new_tokens=8)]
+        b.step(1)
+        uids += [b.submit(p, max_new_tokens=3) for p in prompts[1:]]
+        saw_single_row = False
+        for _ in range(40):
+            b.step(1)
+            widths = [int(e[3].shape[0]) for e in b._parked]
+            if widths == [1]:
+                saw_single_row = True     # last pending row got its own
+            if not b.pending:             # 1-row cache (B-row freed)
+                break
+        assert saw_single_row
+        assert not b.pending
+
+        # exactness: same outputs as a batcher that never parks
+        mesh_mod.set_mesh(None)
+        ref = ContinuousBatcher(eng, n_slots=1, prefill_ahead=0)
+        r0 = ref.run([prompts[0]], ticks=4, max_new_tokens=8)
+        rrest = ref.run(prompts[1:], ticks=4, max_new_tokens=3)
+        for uid, expect in zip(uids, r0 + rrest):
+            np.testing.assert_array_equal(b._finished[uid], expect)
+    finally:
+        mesh_mod.set_mesh(None)
