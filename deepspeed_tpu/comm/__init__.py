@@ -335,6 +335,8 @@ def axis_world_size(axis) -> int:
     from jax import lax
     import numpy as np
 
+    from ..utils import compat
+
     if isinstance(axis, (tuple, list)):
-        return int(np.prod([lax.axis_size(a) for a in axis]))
-    return lax.axis_size(axis)
+        return int(np.prod([compat.axis_size(a) for a in axis]))
+    return compat.axis_size(axis)

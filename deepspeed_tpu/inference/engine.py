@@ -54,6 +54,10 @@ class InferenceConfig:
     replace_with_kernel_inject: bool = True   # accepted; zoo is always "injected"
     checkpoint: Optional[str] = None
     quant: dict = dataclasses.field(default_factory=dict)
+    # fused decode-tick megakernels (ops/pallas/decode_layer.py) for
+    # families with a decode_fused config field; None keeps the model's
+    # own flag.  DS_TPU_DECODE_FUSED env-overrides either way.
+    decode_fused: Optional[bool] = None
 
     @staticmethod
     def load(d) -> "InferenceConfig":
@@ -124,6 +128,10 @@ class InferenceEngine:
                     w8_group=int(q.get("group_size", 128)))
                 # dense *_kernel AND MoE expert wi/wo leaves quantize;
                 # only the tiny gate (wg) stays full width
+        if self.config.decode_fused is not None and \
+                hasattr(cfg, "decode_fused"):
+            self.model_cfg = dataclasses.replace(
+                self.model_cfg, decode_fused=bool(self.config.decode_fused))
         # models name their context-length field differently
         pos_field = "n_positions" if hasattr(cfg, "n_positions") \
             else "max_position_embeddings"

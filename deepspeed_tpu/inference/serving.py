@@ -264,7 +264,20 @@ class ContinuousBatcher:
                     big, small[None].astype(big.dtype),
                     (i,) + (0,) * small.ndim)
 
-            cache = jax.tree_util.tree_map(put, cache, cache1)
+            def put_cache(path, big, small):
+                if getattr(path[-1], "key", None) == "cache_index":
+                    # bucket-padded prefill leaves the write head at the
+                    # PADDED width with K/V garbage at [prompt_len,
+                    # bucket): rewind to the real length so decode ticks
+                    # overwrite the garbage in place — the attention
+                    # length mask (cur+1) then never reads past the last
+                    # real write.  Exact-length prefills rewind to the
+                    # value already there (a no-op).
+                    small = jnp.full_like(small, prompt_len)
+                return put(big, small)
+
+            cache = jax.tree_util.tree_map_with_path(put_cache, cache,
+                                                     cache1)
             token = put(token, first[:, None])
             pos = put(pos, jnp.int32(prompt_len))
             temp = put(temp, r_temp)
@@ -369,22 +382,50 @@ class ContinuousBatcher:
     def _prefill_batch(self, max_new: int):
         """Prefill up to ``max_new`` queued requests and PARK the results.
 
-        Same-length prompts at the queue head share ONE batched prefill
-        (one compiled forward at (B, chunk) instead of B serial B=1
-        prefills — the round-2 serial-admission fix); the first token is
-        sampled here, so TTFT lands NOW even if every slot is busy.  A
-        request finished by its first token (eos or max_new_tokens<=1)
-        completes without ever occupying a slot."""
+        Prompts at the queue head share ONE batched prefill (one compiled
+        forward at (B, chunk) instead of B serial B=1 prefills — the
+        round-2 serial-admission fix); the first token is sampled here, so
+        TTFT lands NOW even if every slot is busy.  With
+        ``chunked_prefill`` the executables are already pow2-bucketed, so
+        the group is ANY run of prompts sharing a pow2 bucket: mixed
+        lengths right-pad to the bucket (pads embed but are never
+        attended — their K/V garbage sits past each row's rewound write
+        head, see ``place_fn`` — and each row samples from its REAL last
+        token's logits).  Mixed-length bursts stop degenerating into B
+        serial prefills.  Without ``chunked_prefill`` only exactly-equal
+        lengths group (the pre-bucketing behavior).  A request finished by
+        its first token (eos or max_new_tokens<=1) completes without ever
+        occupying a slot."""
         while self._queue and max_new > 0:
             plen = len(self._queue[0].prompt)
+            bucket = 1 << (plen - 1).bit_length()
+            bucketed = self.chunked_prefill and \
+                bucket <= self.engine._gen_limit
+
+            def same_group(r):
+                if bucketed:
+                    return 1 << (len(r.prompt) - 1).bit_length() == bucket
+                return len(r.prompt) == plen
+
             reqs = [self._queue.popleft()]
             while (self._queue and len(reqs) < max_new
-                   and len(self._queue[0].prompt) == plen):
+                   and same_group(self._queue[0])):
                 reqs.append(self._queue.popleft())
             max_new -= len(reqs)
             B = len(reqs)
-            ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
-            logits, cacheB = self._prefill(ids)
+            lens = np.asarray([len(r.prompt) for r in reqs], np.int32)
+            if bucketed and (lens != lens[0]).any():
+                ids_np = np.full((B, bucket), self.pad, np.int32)
+                for row, r in enumerate(reqs):
+                    ids_np[row, :lens[row]] = r.prompt
+                logits, cacheB = self._prefill(jnp.asarray(ids_np))
+                # per-row REAL last-token logits (the pad positions'
+                # logits are sampling garbage)
+                last = logits[jnp.arange(B), jnp.asarray(lens) - 1][:, None]
+            else:   # uniform length: exact prefill, no pad compute
+                ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
+                logits, cacheB = self._prefill(ids)
+                last = logits[:, -1:, :]
             # fixed shapes only reach the jitted sampler: the last-token
             # logits rows and a HOST-built (B, 1, V) prompt mask — so it
             # compiles once per batch width across all prompt lengths
@@ -392,7 +433,7 @@ class ContinuousBatcher:
             for row, req in enumerate(reqs):
                 prompt_seen[row, 0, req.prompt] = True
             firstB, seen1B = self._first_token_batch(
-                logits[:, -1:, :], jnp.asarray(prompt_seen),
+                last, jnp.asarray(prompt_seen),
                 jnp.asarray([r.uid for r in reqs], jnp.int32),
                 jnp.asarray([r.temperature for r in reqs], jnp.float32),
                 jnp.asarray([r.top_p for r in reqs], jnp.float32),
@@ -571,7 +612,8 @@ class ContinuousBatcher:
             self.step(ticks=ticks)
         return [self._finished[u] for u in uids]
 
-    def warmup_windows(self, ticks: int, greedy: bool = True) -> None:
+    def warmup_windows(self, ticks: int, greedy: bool = True,
+                       admission: bool = True) -> None:
         """AOT-compile every pow2 sub-window executable ≤ ``ticks``.
 
         Sub-window scheduling picks pow2 window lengths; without this,
@@ -581,7 +623,15 @@ class ContinuousBatcher:
         ``greedy`` picks the sampler variant to warm (the all-greedy pool
         executable by default; a pool with any sampled request lazily
         compiles the general variant on first use — call again with
-        ``greedy=False`` to pre-warm it too)."""
+        ``greedy=False`` to pre-warm it too).
+
+        ``admission=True`` additionally warms the admission-side
+        executables — ``serving.first_token`` / ``serving.place`` /
+        ``serving.extract_row`` at the common batch widths (1 and
+        ``n_slots``): those compile per parked-batch width, and without
+        the warmup the FIRST burst pays all three compiles inside TTFT
+        (the decode windows alone left seconds of admission compile in
+        the measured first-token path)."""
         s = 1
         while s <= int(ticks):
             self._multi_step(s, greedy).lower(
@@ -590,6 +640,37 @@ class ContinuousBatcher:
                 self._rep, self._seen, self._done, jnp.int32(0),
                 jnp.int32(self.eos), jnp.int32(self.pad)).compile()
             s <<= 1
+        if admission:
+            self._warmup_admission()
+
+    def _warmup_admission(self) -> None:
+        """Pre-compile the admission executables for batch widths 1 and
+        ``n_slots``.  Scalar args mirror the live call sites exactly
+        (python ints/floats → weak-typed scalars; a strongly-typed dummy
+        would compile a DIFFERENT executable and the warmup would miss).
+        """
+        V = self._vocab
+        dtype = self.engine.model_cfg.dtype
+        sds = jax.ShapeDtypeStruct
+        for B in sorted({1, self.n_slots}):
+            # abstract operands only: .lower() needs shapes, and a real
+            # init_cache(B) would zero-fill a full B-row KV cache in HBM
+            # just to compile
+            logits = sds((B, 1, V), dtype)
+            seen = sds((B, 1, V), jnp.bool_)
+            uids = sds((B,), jnp.int32)
+            f32 = sds((B,), jnp.float32)
+            self._first_token_batch.lower(
+                logits, seen, uids, f32, f32, f32).compile()
+            cacheB = jax.eval_shape(lambda: self.engine.init_cache(B))
+            firstB = sds((B, 1), jnp.int32)
+            self._place_fn.lower(
+                self._cache, self._token, self._pos, self._temp,
+                self._top_p, self._rep, self._seen, self._done,
+                cacheB, firstB, seen, 0, 1, 0, 0.0, 1.0, 1.0).compile()
+            if B > 1:
+                self._extract_row_fn.lower(
+                    cacheB, firstB, seen, 0).compile()
 
     # ------------------------------------------------------------------
     def reset_latency_stats(self) -> None:

@@ -22,6 +22,7 @@ Logical axis vocabulary used by every model in the zoo:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -149,6 +150,218 @@ def offloadable_policy_name(name: str) -> str:
 def param_with_axes(init_fn, names: tuple):
     """Box an initializer with logical partition names (flax metadata)."""
     return nn.with_partitioning(init_fn, names)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    """fp32 LayerNorm over the last dim, cast back to ``x.dtype`` — the
+    ONE norm math shared by every zoo family's norm module and by the
+    fused decode kernels' XLA fallback (drift here would silently break
+    the fused/unfused parity contract)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(dtype)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """fp32 RMSNorm (LLaMA) — see :func:`layer_norm` for the sharing
+    contract."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf ** 2, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * scale).astype(dtype)
+
+
+def declare_fused_proj(module: nn.Module, cfg, name: str, names: tuple,
+                       in_features: int, features: int, *,
+                       init_std: Optional[float] = None,
+                       bias: bool = False):
+    """Declare a dense projection's arrays for the fused decode path —
+    the (fp kernel | W8A16 codes+scales pair)[, bias] — with EXACTLY the
+    param names/shapes/init the family's ``_dense`` would create, so
+    checkpoints load interchangeably across the fused and unfused paths
+    (one helper, not one copy per family, so they cannot drift)."""
+    if getattr(cfg, "w8", False):
+        from ..ops.w8 import declare_w8_dense
+
+        w = declare_w8_dense(module, name, names, in_features, features,
+                             cfg.w8_group)
+    else:
+        std = cfg.initializer_range if init_std is None else init_std
+        w = module.param(
+            name + "_kernel",
+            nn.with_partitioning(nn.initializers.normal(std), names),
+            (in_features, features), cfg.param_dtype).astype(cfg.dtype)
+    if not bias:
+        return w
+    b = module.param(name + "_bias",
+                     nn.with_partitioning(nn.initializers.zeros, (names[-1],)),
+                     (features,), cfg.param_dtype)
+    return w, b.astype(cfg.dtype)
+
+
+def append_kv_cache(module: nn.Module, k: jax.Array, v: jax.Array,
+                    cache_len: int, dtype):
+    """Append this step's K/V ``(B, S, H, D)`` into the module's mutable
+    ``cache`` collection (the reference softmax.cu context-cache analog)
+    and return ``(k_cache, v_cache, cur)`` — the ONE cache layout shared
+    by every decoder family and by both the XLA and fused decode paths,
+    so it cannot drift between them."""
+    B, S, H, D = k.shape
+    ck = module.variable("cache", "cached_key", jnp.zeros,
+                         (B, cache_len, H, D), dtype)
+    cv = module.variable("cache", "cached_value", jnp.zeros,
+                         (B, cache_len, H, D), dtype)
+    idx = module.variable("cache", "cache_index",
+                          lambda: jnp.zeros((), jnp.int32))
+    cur = idx.value
+    ck.value = jax.lax.dynamic_update_slice(
+        ck.value, k.astype(dtype), (0, cur, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(
+        cv.value, v.astype(dtype), (0, cur, 0, 0))
+    idx.value = cur + S
+    return ck.value, cv.value, cur
+
+
+# ---------------------------------------------------------------------------
+# Fused decode-tick dispatch (ops/pallas/decode_layer.py megakernels)
+# ---------------------------------------------------------------------------
+#
+# The single dispatch point the gpt2/llama/neox decode paths share: a
+# ``decode_fused`` config flag (or the DS_TPU_DECODE_FUSED env override)
+# turns the per-layer decode op chain into two Pallas launches around
+# ``decode_attention``; ``decode_fused_plan`` mirrors ``decode_supported``
+# — unsupported shapes silently keep the XLA path.
+
+DECODE_FUSED_ENV = "DS_TPU_DECODE_FUSED"
+
+
+def _decode_fused_metrics():
+    # one set of cells shared with the kernels' own vmap-fold detour
+    # counting (see decode_layer.decode_fused_metrics)
+    from ..ops.pallas.decode_layer import decode_fused_metrics
+
+    return decode_fused_metrics()
+
+
+def decode_fused_mode(cfg) -> Optional[str]:
+    """``None`` (off) | ``"kernel"`` (TPU) | ``"interpret"`` (non-TPU:
+    the interpreter runs the same kernels for CPU-mesh parity/smoke).
+
+    ``DS_TPU_DECODE_FUSED=0/false/off`` force-disables;
+    ``=1/true/on`` force-enables over a False config flag."""
+    env = os.environ.get(DECODE_FUSED_ENV, "").lower()
+    if env in ("0", "false", "off"):
+        return None
+    enabled = bool(getattr(cfg, "decode_fused", False)) or \
+        env in ("1", "true", "on")
+    if not enabled:
+        return None
+    from ..ops.attention import on_tpu
+
+    return "kernel" if on_tpu() else "interpret"
+
+
+def _w8_groups(cfg, k: int) -> int:
+    if not getattr(cfg, "w8", False):
+        return 1
+    from ..ops.w8 import w8_group_size
+
+    return k // w8_group_size(k, int(getattr(cfg, "w8_group", 128)))
+
+
+def decode_fused_plan(cfg, rows: int, e: int, proj_outs: tuple,
+                      f: int, swiglu: bool = False) -> Optional[dict]:
+    """Decide whether THIS decode tick takes the megakernel path.
+
+    ``rows``: B·S of the tick (per-slot 1 under the serving vmap — the
+    kernels' custom_vmap folds slots back into rows); ``proj_outs``: the
+    attention projection widths (one fused panel, or q/k/v for GQA);
+    ``f``: MLP hidden width; ``swiglu``: the 3-panel MLP (LLaMA) vs the
+    GELU pair.  Returns ``{"interpret": bool}`` or None (caller keeps
+    the stock XLA path)."""
+    mode = decode_fused_mode(cfg)
+    if mode is None:
+        return None
+    from ..ops.pallas.decode_layer import (norm_proj_supported,
+                                           post_attn_supported)
+    # the megakernels carry no shard_map wrapper: a mesh that SHARDS the
+    # decode step's operands (tp splits the weight panels, sp/pp are
+    # manual regions) keeps the XLA chain, whose collectives the
+    # partitioner handles.  Pure data axes are fine — serving state and
+    # weights are replicated across them.
+    from ..comm.mesh import get_mesh
+
+    mesh = get_mesh(required=False)
+    if mesh is not None and any(mesh.shape.get(a, 1) > 1
+                                for a in ("tp", "sp", "pp")):
+        _decode_fused_metrics()[2].inc()
+        return None
+    w8 = bool(getattr(cfg, "w8", False))
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    ok = all(norm_proj_supported(rows, e, n, itemsize, w8, _w8_groups(cfg, e))
+             for n in proj_outs)
+    ok = ok and post_attn_supported(rows, e, f, itemsize, w8,
+                                    _w8_groups(cfg, e), _w8_groups(cfg, f),
+                                    swiglu=swiglu)
+    if not ok:
+        _decode_fused_metrics()[2].inc()
+        return None
+    return {"interpret": mode == "interpret"}
+
+
+def fused_decode_qkv(x, norm_scale, norm_bias, weight, bias, *, rms: bool,
+                     eps: float, interpret: bool):
+    """norm → projection for the decode tick: Pallas kernel, with the
+    XLA chain as a graceful fallback if the kernel refuses at trace."""
+    from ..ops.pallas.decode_layer import (fused_norm_proj,
+                                           reference_norm_proj)
+    from ..ops.pallas.spmd import _warn_once
+
+    m_qkv, _, m_fallback = _decode_fused_metrics()
+    try:
+        out = fused_norm_proj(x, norm_scale, norm_bias, weight, bias,
+                              rms=rms, eps=eps, interpret=interpret)
+        m_qkv.inc()
+        return out
+    except Exception as e:   # unsupported shape/backend: keep serving
+        _warn_once("decode_ln_qkv", f"{type(e).__name__}: {e}"[:200])
+        m_fallback.inc()
+        return reference_norm_proj(x, norm_scale, norm_bias, weight, bias,
+                                   rms=rms, eps=eps)
+
+
+def fused_decode_post_attn(y, x, wo, bo, norm_scale, norm_bias,
+                           mlp_weights, *, swiglu: bool = False,
+                           rms: bool = False, eps: float = 1e-5,
+                           exact_gelu: bool = False,
+                           parallel_residual: bool = False,
+                           interpret: bool = False):
+    """o-proj + residual → norm → MLP → residual for the decode tick,
+    with the exact unfused op chain as fallback."""
+    from ..ops.pallas.decode_layer import (fused_post_attn,
+                                           reference_post_attn)
+    from ..ops.pallas.spmd import _warn_once
+
+    _, m_post, m_fallback = _decode_fused_metrics()
+    try:
+        out = fused_post_attn(y, x, wo, bo, norm_scale, norm_bias,
+                              mlp_weights, swiglu=swiglu, rms=rms, eps=eps,
+                              exact_gelu=exact_gelu,
+                              parallel_residual=parallel_residual,
+                              interpret=interpret)
+        m_post.inc()
+        return out
+    except Exception as e:
+        _warn_once("decode_post_attn", f"{type(e).__name__}: {e}"[:200])
+        m_fallback.inc()
+        return reference_post_attn(
+            y, x, wo, bo, norm_scale, norm_bias, mlp_weights,
+            swiglu=swiglu, rms=rms, eps=eps, exact_gelu=exact_gelu,
+            parallel_residual=parallel_residual)
 
 
 def cross_entropy_loss(

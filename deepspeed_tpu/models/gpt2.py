@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import dot_product_attention, on_tpu
+from ..utils import compat as _compat
 from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift_labels
 
 
@@ -71,6 +72,12 @@ class GPT2Config:
     # (reference pt_binding.cpp:622 int8 GEMMs).  Set by init_inference.
     w8: bool = False
     w8_group: int = 128
+    # fused decode-tick megakernels (ops/pallas/decode_layer.py): the
+    # per-layer decode chain collapses to LN->QKV and o-proj->LN->MLP
+    # Pallas launches around decode_attention; DS_TPU_DECODE_FUSED
+    # env-overrides.  Default off pending the e2e sweep (repo law: only
+    # e2e sweeps flip perf defaults).
+    decode_fused: bool = False
     # chunked tied-head loss (common.chunked_lm_loss): token rows per
     # chunk; None = dense logits.  Saves the (B,S,V) fp32 logits+cotangent
     # at large micro sizes; the model output then carries no "logits".
@@ -162,61 +169,85 @@ def _dense(x, features, names, *, cfg: GPT2Config, name: str, module: nn.Module,
 
 class LayerNorm(nn.Module):
     """fp32 layernorm with annotated scale/bias (reference fuses this in
-    ``csrc/transformer/normalize_kernels.cu``; XLA fuses it for us)."""
+    ``csrc/transformer/normalize_kernels.cu``; XLA fuses it for us).
+    ``params_only=True`` declares and returns (scale, bias) without
+    normalizing — the fused decode path folds the norm into its Pallas
+    kernel but must keep this module's param names/shapes."""
 
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
-        dtype = x.dtype
-        x = x.astype(jnp.float32)
-        mean = x.mean(-1, keepdims=True)
-        var = ((x - mean) ** 2).mean(-1, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_epsilon)
+    def __call__(self, x, params_only: bool = False):
         scale = self.param("scale", nn.with_partitioning(nn.initializers.ones, ("embed",)),
                            (x.shape[-1],), self.cfg.param_dtype)
         bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
                           (x.shape[-1],), self.cfg.param_dtype)
-        return (y * scale + bias).astype(dtype)
+        if params_only:
+            return scale, bias
+        from .common import layer_norm
+
+        return layer_norm(x, scale, bias, self.cfg.layer_norm_epsilon)
 
 
 class SelfAttention(nn.Module):
     cfg: GPT2Config
 
-    @nn.compact
-    def __call__(self, x, attn_mask, deterministic: bool):
+    def _cache_append(self, k, v):
+        from .common import append_kv_cache
+
+        cfg = self.cfg
+        return append_kv_cache(self, k, v,
+                               cfg.cache_len or cfg.n_positions, cfg.dtype)
+
+    def _fused_decode(self, x, attn_mask, fused_ln):
+        """Megakernel decode prologue: LN folded into the QKV projection
+        kernel (``x`` is the RAW residual stream).  Returns the
+        PRE-o-proj head mix plus the declared o-proj params — the o-proj
+        runs inside the fused post-attention kernel at the Block level."""
         cfg = self.cfg
         B, S, E = x.shape
         H, D = cfg.n_head, cfg.head_dim
+        ns, nb, interp = fused_ln
+        from .common import declare_fused_proj, fused_decode_qkv
+
+        w, b = declare_fused_proj(self, cfg, "c_attn", ("embed", "qkv"),
+                                  E, 3 * E, bias=True)
+        qkv = fused_decode_qkv(x, ns, nb, w, b, rms=False,
+                               eps=cfg.layer_norm_epsilon,
+                               interpret=interp)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        kc, vc, cur = self._cache_append(k.reshape(B, S, H, D),
+                                         v.reshape(B, S, H, D))
+        from ..ops.attention import cached_decode_attention
+
+        y = cached_decode_attention(q.reshape(B, S, H, D), kc, vc, cur,
+                                    attn_mask)
+        y = y.reshape(B, S, E)
+        proj_std = cfg.initializer_range / (2 * cfg.n_layer) ** 0.5
+        wo, bo = declare_fused_proj(self, cfg, "c_proj",
+                                    ("heads", "embed"), E, E,
+                                    init_std=proj_std, bias=True)
+        return y, (wo, bo)
+
+    @nn.compact
+    def __call__(self, x, attn_mask, deterministic: bool, fused_ln=None):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+        if fused_ln is not None:
+            return self._fused_decode(x, attn_mask, fused_ln)
         qkv = _dense(x, 3 * E, ("embed", "qkv"), cfg=cfg, name="c_attn", module=self)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D)
         k = k.reshape(B, S, H, D)
         v = v.reshape(B, S, H, D)
         if cfg.decode:
-            # KV-cache: the analog of the inference kernel's context cache
-            # (reference csrc/transformer/inference/csrc/softmax.cu keeps
-            # triangular-masked history; here it's a mutable 'cache'
-            # collection updated in place, static max length)
-            CL = cfg.cache_len or cfg.n_positions
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-            idx.value = cur + S
+            kc, vc, cur = self._cache_append(k, v)
             # fused-or-fallback dispatch shared by all decoder families
             # (the softmax_context analog, ops/pallas/decode_attention.py)
             from ..ops.attention import cached_decode_attention
 
-            y = cached_decode_attention(q, ck.value, cv.value, cur,
-                                        attn_mask)
+            y = cached_decode_attention(q, kc, vc, cur, attn_mask)
             y = y.reshape(B, S, E)
             out = _dense(y, E, ("heads", "embed"), cfg=cfg, name="c_proj", module=self,
                          init_std=cfg.initializer_range / (2 * cfg.n_layer) ** 0.5)
@@ -246,10 +277,21 @@ class MLP(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool):
+    def __call__(self, x, deterministic: bool, params_only: bool = False):
         cfg = self.cfg
         E, F = cfg.n_embd, 4 * cfg.n_embd
         proj_std = cfg.initializer_range / (2 * cfg.n_layer) ** 0.5
+        if params_only:
+            # declare (identically to the compute path) and hand the
+            # arrays to the fused decode-tick kernel at the Block level
+            from .common import declare_fused_proj
+
+            w1, b1 = declare_fused_proj(self, cfg, "c_fc", ("embed", "mlp"),
+                                        E, F, bias=True)
+            w2, b2 = declare_fused_proj(self, cfg, "c_proj",
+                                        ("mlp", "embed"), F, E,
+                                        init_std=proj_std, bias=True)
+            return w1, b1, w2, b2
         if self._use_fused():
             # single-kernel FFN: hidden tile never leaves VMEM (the
             # bandwidth hot spot — see ops/pallas/fused_mlp.py)
@@ -300,6 +342,30 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, inputs):
         attn_mask, pld_theta = inputs if isinstance(inputs, tuple) else (inputs, None)
+        cfg = self.cfg
+
+        if cfg.decode and x.shape[1] == 1 and cfg.moe is None \
+                and pld_theta is None:
+            # single-token tick: try the decode-row megakernel pair
+            # (common.decode_fused_plan mirrors decode_supported — None
+            # keeps the stock XLA chain below, silently)
+            from .common import decode_fused_plan, fused_decode_post_attn
+
+            plan = decode_fused_plan(cfg, x.shape[0] * x.shape[1],
+                                     cfg.n_embd, (3 * cfg.n_embd,),
+                                     4 * cfg.n_embd)
+            if plan is not None:
+                interp = plan["interpret"]
+                ns1, nb1 = LayerNorm(cfg, name="ln_1")(x, params_only=True)
+                y, (wo, bo) = SelfAttention(cfg, name="attn")(
+                    x, attn_mask, True, fused_ln=(ns1, nb1, interp))
+                ns2, nb2 = LayerNorm(cfg, name="ln_2")(x, params_only=True)
+                mlp_w = MLP(cfg, name="mlp")(x, True, params_only=True)
+                x = fused_decode_post_attn(
+                    y, x, wo, bo, ns2, nb2, mlp_w, rms=False,
+                    eps=cfg.layer_norm_epsilon, exact_gelu=False,
+                    parallel_residual=False, interpret=interp)
+                return x, jnp.zeros((), jnp.float32)
 
         def survive(branch):
             # stochastic depth (PLD, reference progressive_layer_drop.py):
@@ -553,7 +619,7 @@ class GPT2LMHeadModel(nn.Module):
             def stage_fn(stage_params, h, chunk_slot=None):
                 sid = jax.lax.axis_index("pp")
                 g = sid if chunk_slot is None \
-                    else chunk_slot * jax.lax.axis_size("pp") + sid
+                    else chunk_slot * _compat.axis_size("pp") + sid
                 n_real = jnp.asarray(counts, jnp.int32)[g]
 
                 def body(carry, xs):

@@ -50,6 +50,9 @@ class GPTNeoXConfig:
     # weight-only int8 serving (ops/w8.py W8A16); set by init_inference
     w8: bool = False
     w8_group: int = 128
+    # fused decode-tick megakernels (ops/pallas/decode_layer.py); see
+    # GPT2Config.decode_fused.  DS_TPU_DECODE_FUSED env-overrides.
+    decode_fused: bool = False
     moe: Optional[Any] = None
 
     @property
@@ -106,29 +109,65 @@ class NeoXLayerNorm(nn.Module):
     cfg: GPTNeoXConfig
 
     @nn.compact
-    def __call__(self, x):
-        dtype = x.dtype
-        x = x.astype(jnp.float32)
-        mean = x.mean(-1, keepdims=True)
-        var = ((x - mean) ** 2).mean(-1, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + self.cfg.layer_norm_eps)
+    def __call__(self, x, params_only: bool = False):
         scale = self.param("scale", nn.with_partitioning(nn.initializers.ones,
                                                          ("embed",)),
                            (x.shape[-1],), self.cfg.param_dtype)
         bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros,
                                                        ("embed",)),
                           (x.shape[-1],), self.cfg.param_dtype)
-        return (y * scale + bias).astype(dtype)
+        if params_only:
+            return scale, bias
+        from .common import layer_norm
+
+        return layer_norm(x, scale, bias, self.cfg.layer_norm_eps)
 
 
 class NeoXAttention(nn.Module):
     cfg: GPTNeoXConfig
 
-    @nn.compact
-    def __call__(self, x, position_ids, attn_mask):
+    def _cache_append(self, k, v):
+        from .common import append_kv_cache
+
+        cfg = self.cfg
+        return append_kv_cache(self, k, v,
+                               cfg.cache_len or cfg.max_position_embeddings,
+                               cfg.dtype)
+
+    def _fused_decode(self, x, position_ids, attn_mask, fused_ln):
+        """Megakernel prologue: LN folded into the interleaved QKV
+        projection kernel; partial rotary and decode attention between
+        the fusion groups."""
         cfg = self.cfg
         B, S, E = x.shape
         H, D = cfg.num_attention_heads, cfg.head_dim
+        ns, nb, interp = fused_ln
+        from .common import declare_fused_proj, fused_decode_qkv
+
+        w, b = declare_fused_proj(self, cfg, "qkv", ("embed", "qkv"), E,
+                                  3 * E, bias=True)
+        qkv = fused_decode_qkv(x, ns, nb, w, b, rms=False,
+                               eps=cfg.layer_norm_eps, interpret=interp)
+        qkv = qkv.reshape(B, S, H, 3, D)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        q, k = apply_rotary_pos_emb(q, k, position_ids, cfg.rotary_dim,
+                                    cfg.rotary_emb_base)
+        kc, vc, cur = self._cache_append(k, v)
+        from ..ops.attention import cached_decode_attention
+
+        y = cached_decode_attention(q, kc, vc, cur, attn_mask)
+        y = y.reshape(B, S, E)
+        wo, bo = declare_fused_proj(self, cfg, "dense", ("heads", "embed"),
+                                    E, E, bias=True)
+        return y, (wo, bo)
+
+    @nn.compact
+    def __call__(self, x, position_ids, attn_mask, fused_ln=None):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        if fused_ln is not None:
+            return self._fused_decode(x, position_ids, attn_mask, fused_ln)
         # HF NeoX packs qkv per-head interleaved: (H, 3, D); we store a
         # fused (E, 3E) kernel in the same interleaved order (the
         # conversion policy handles the permutation)
@@ -138,24 +177,11 @@ class NeoXAttention(nn.Module):
         q, k = apply_rotary_pos_emb(q, k, position_ids, cfg.rotary_dim,
                                     cfg.rotary_emb_base)
         if cfg.decode:
-            CL = cfg.cache_len or cfg.max_position_embeddings
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-            idx.value = cur + S
+            kc, vc, cur = self._cache_append(k, v)
             # shared fused-or-fallback dispatch (ops/attention.py)
             from ..ops.attention import cached_decode_attention
 
-            y = cached_decode_attention(q, ck.value, cv.value, cur,
-                                        attn_mask)
+            y = cached_decode_attention(q, kc, vc, cur, attn_mask)
         else:
             y = dot_product_attention(q, k, v, causal=True, mask=attn_mask,
                                       impl=cfg.attn_impl)
@@ -171,6 +197,36 @@ class NeoXBlock(nn.Module):
     def __call__(self, x, inputs):
         position_ids, attn_mask = inputs
         cfg = self.cfg
+        if cfg.decode and x.shape[1] == 1 and cfg.moe is None:
+            from .common import decode_fused_plan, fused_decode_post_attn
+
+            E, I = cfg.hidden_size, cfg.intermediate_size
+            plan = decode_fused_plan(cfg, x.shape[0] * x.shape[1], E,
+                                     (3 * E,), I)
+            if plan is not None:
+                interp = plan["interpret"]
+                ns1, nb1 = NeoXLayerNorm(cfg, name="input_ln")(
+                    x, params_only=True)
+                y, (wo, bo) = NeoXAttention(cfg, name="attention")(
+                    x, position_ids, attn_mask, fused_ln=(ns1, nb1, interp))
+                ns2, nb2 = NeoXLayerNorm(cfg, name="post_attention_ln")(
+                    x, params_only=True)
+                from .common import declare_fused_proj
+
+                w1, b1 = declare_fused_proj(self, cfg, "dense_h_to_4h",
+                                            ("embed", "mlp"), E, I,
+                                            bias=True)
+                w2, b2 = declare_fused_proj(self, cfg, "dense_4h_to_h",
+                                            ("mlp", "embed"), I, E,
+                                            bias=True)
+                # parallel residual: the MLP reads LN2(x); the sequential
+                # variant reads LN2(x + attn) — both are one kernel flag
+                x = fused_decode_post_attn(
+                    y, x, wo, bo, ns2, nb2, (w1, b1, w2, b2), rms=False,
+                    eps=cfg.layer_norm_eps, exact_gelu=True,
+                    parallel_residual=cfg.use_parallel_residual,
+                    interpret=interp)
+                return x, jnp.zeros((), jnp.float32)
         attn = NeoXAttention(cfg, name="attention")(
             NeoXLayerNorm(cfg, name="input_ln")(x), position_ids, attn_mask)
         h_in = NeoXLayerNorm(cfg, name="post_attention_ln")(

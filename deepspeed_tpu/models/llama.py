@@ -48,6 +48,9 @@ class LlamaConfig:
     # weight-only int8 serving (ops/w8.py W8A16); set by init_inference
     w8: bool = False
     w8_group: int = 128
+    # fused decode-tick megakernels (ops/pallas/decode_layer.py); see
+    # GPT2Config.decode_fused.  DS_TPU_DECODE_FUSED env-overrides.
+    decode_fused: bool = False
 
     @property
     def padded_vocab_size(self) -> int:
@@ -101,25 +104,67 @@ class RMSNorm(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
-        dtype = x.dtype
-        xf = x.astype(jnp.float32)
-        var = jnp.mean(xf ** 2, axis=-1, keepdims=True)
-        y = xf * jax.lax.rsqrt(var + self.cfg.rms_norm_eps)
+    def __call__(self, x, params_only: bool = False):
         scale = self.param("scale", nn.with_partitioning(nn.initializers.ones,
                                                          ("embed",)),
                            (x.shape[-1],), self.cfg.param_dtype)
-        return (y * scale).astype(dtype)
+        if params_only:
+            return scale
+        from .common import rms_norm
+
+        return rms_norm(x, scale, self.cfg.rms_norm_eps)
 
 
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
-    @nn.compact
-    def __call__(self, x, position_ids, attn_mask):
+    def _cache_append(self, k, v):
+        from .common import append_kv_cache
+
+        cfg = self.cfg
+        return append_kv_cache(self, k, v,
+                               cfg.cache_len or cfg.max_position_embeddings,
+                               cfg.dtype)
+
+    def _fused_decode(self, x, position_ids, attn_mask, fused_norm):
+        """Megakernel prologue: RMSNorm folded into each of the split
+        q/k/v projection kernels (GQA keeps KV panels narrow); rotary and
+        the decode-attention kernel run between the fusion groups."""
         cfg = self.cfg
         B, S, E = x.shape
         H, KV, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        ns, interp = fused_norm
+        from .common import fused_decode_qkv
+
+        from .common import declare_fused_proj
+
+        def proj(name, names, feat):
+            w = declare_fused_proj(self, cfg, name, names, E, feat)
+            return fused_decode_qkv(x, ns, None, w, None, rms=True,
+                                    eps=cfg.rms_norm_eps, interpret=interp)
+
+        q = proj("q_proj", ("embed", "qkv"), H * D).reshape(B, S, H, D)
+        k = proj("k_proj", ("embed", "kv"), KV * D).reshape(B, S, KV, D)
+        v = proj("v_proj", ("embed", "kv"), KV * D).reshape(B, S, KV, D)
+        q, k = apply_rotary_pos_emb(q, k, position_ids, rotary_dim=D,
+                                    theta=cfg.rope_theta)
+        kc, vc, cur = self._cache_append(k, v)
+        from ..ops.attention import cached_decode_attention
+
+        y = cached_decode_attention(q, kc, vc, cur, attn_mask)
+        y = y.reshape(B, S, H * D)
+        wo = declare_fused_proj(self, cfg, "o_proj", ("heads", "embed"),
+                                H * D, E)
+        return y, wo
+
+    @nn.compact
+    def __call__(self, x, position_ids, attn_mask, fused_norm=None):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, KV, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        if fused_norm is not None:
+            return self._fused_decode(x, position_ids, attn_mask,
+                                      fused_norm)
         q = _dense(x, H * D, ("embed", "qkv"), cfg=cfg, name="q_proj",
                    module=self).reshape(B, S, H, D)
         k = _dense(x, KV * D, ("embed", "kv"), cfg=cfg, name="k_proj",
@@ -129,25 +174,12 @@ class LlamaAttention(nn.Module):
         q, k = apply_rotary_pos_emb(q, k, position_ids, rotary_dim=D,
                                     theta=cfg.rope_theta)
         if cfg.decode:
-            CL = cfg.cache_len or cfg.max_position_embeddings
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, CL, KV, D), cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, CL, KV, D), cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-            idx.value = cur + S
+            kc, vc, cur = self._cache_append(k, v)
             # shared fused-or-fallback dispatch; GQA-aware (KV panels stay
             # at KV heads on the kernel path — no repeat materialized)
             from ..ops.attention import cached_decode_attention
 
-            y = cached_decode_attention(q, ck.value, cv.value, cur,
-                                        attn_mask)
+            y = cached_decode_attention(q, kc, vc, cur, attn_mask)
             y = y.reshape(B, S, H * D)
             return _dense(y, E, ("heads", "embed"), cfg=cfg,
                           name="o_proj", module=self)
@@ -170,6 +202,35 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, inputs):
         position_ids, attn_mask = inputs
         cfg = self.cfg
+        if cfg.decode and x.shape[1] == 1:
+            from .common import decode_fused_plan, fused_decode_post_attn
+
+            H, KV, D = (cfg.num_attention_heads, cfg.kv_heads,
+                        cfg.head_dim)
+            E, I = cfg.hidden_size, cfg.intermediate_size
+            plan = decode_fused_plan(cfg, x.shape[0] * x.shape[1], E,
+                                     (H * D, KV * D, KV * D), I,
+                                     swiglu=True)
+            if plan is not None:
+                from .common import declare_fused_proj
+
+                interp = plan["interpret"]
+                attn = LlamaAttention(cfg, name="self_attn")
+                ns1 = RMSNorm(cfg, name="input_norm")(x, params_only=True)
+                y, wo = attn(x, position_ids, attn_mask,
+                             fused_norm=(ns1, interp))
+                ns2 = RMSNorm(cfg, name="post_attention_norm")(
+                    x, params_only=True)
+                wg = declare_fused_proj(self, cfg, "gate_proj",
+                                        ("embed", "mlp"), E, I)
+                wu = declare_fused_proj(self, cfg, "up_proj",
+                                        ("embed", "mlp"), E, I)
+                wd = declare_fused_proj(self, cfg, "down_proj",
+                                        ("mlp", "embed"), I, E)
+                x = fused_decode_post_attn(
+                    y, x, wo, None, ns2, None, (wg, wu, wd), swiglu=True,
+                    rms=True, eps=cfg.rms_norm_eps, interpret=interp)
+                return x, None
         x = x + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg, name="input_norm")(x), position_ids, attn_mask)
         h = RMSNorm(cfg, name="post_attention_norm")(x)

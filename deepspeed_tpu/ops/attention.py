@@ -111,7 +111,7 @@ def _flash_spmd(q, k, v, *, causal, scale, interpret=False, flash_opts=None):
     try:
         if verdict == "direct":
             return kern(q, k, v)
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = get_mesh()
@@ -160,7 +160,7 @@ def _flash_jax(q, k, v, *, causal, scale):
     try:
         if verdict == "direct":
             return kern(q, k, v)
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = get_mesh()
@@ -231,7 +231,7 @@ def sp_flash_spec(mesh, batch_size: int, heads: int):
 def _sp_attention(q, k, v, *, causal, scale, kind):
     from functools import partial
 
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..comm.mesh import get_mesh

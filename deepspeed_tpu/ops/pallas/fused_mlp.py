@@ -268,7 +268,7 @@ def fused_mlp_spmd(x, w1, b1, w2, b2, *, block_rows: int = 128,
         if verdict == "direct":
             return fused_mlp(x, w1, b1, w2, b2, block_rows=block_rows,
                              interpret=interpret)
-        from jax import shard_map
+        from ...utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ...comm.mesh import get_mesh

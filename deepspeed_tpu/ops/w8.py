@@ -41,7 +41,7 @@ def quantize_weight(w: jax.Array, group: int = 128):
             jnp.asarray(w))
         return codes, scale
     K, N = w.shape
-    g = group if K % group == 0 else K
+    g = w8_group_size(K, group)
     wf = jnp.asarray(w, jnp.float32).reshape(K // g, g, N)
     amax = jnp.max(jnp.abs(wf), axis=1, keepdims=True)        # (G, 1, N)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
@@ -95,14 +95,25 @@ def w8a16_matmul(x: jax.Array, codes: jax.Array, scale: jax.Array):
     return y.astype(x.dtype)
 
 
+def w8_group_size(k: int, group: int) -> int:
+    """Effective contraction-group size for a K-row panel: ``group`` when
+    it divides K, else one whole-K group — the ONE rule shared by
+    :func:`quantize_weight`, :func:`declare_w8_dense` and the fused
+    decode-kernel dispatch (``models/common.decode_fused_plan``), so the
+    stored scale shapes and the kernels' group loops can never drift."""
+    return group if k % group == 0 else k
+
+
 def declare_w8_dense(module, name: str, names: tuple, in_features: int,
                      features: int, group: int):
     """Declare the (codes, scales) param pair a W8A16 dense layer stores
     IN PLACE of its fp kernel — shared by every model family's ``_dense``
-    so the names/shapes always line up with :func:`quantize_dense_tree`."""
+    so the names/shapes always line up with :func:`quantize_dense_tree`.
+    The fused decode megakernels (``ops/pallas/decode_layer.py``) consume
+    the same pair directly, dequantizing inside their contractions."""
     import flax.linen as nn
 
-    g = group if in_features % group == 0 else in_features
+    g = w8_group_size(in_features, group)
     codes = module.param(
         name + "_kernel_q",
         nn.with_partitioning(nn.initializers.zeros, names),

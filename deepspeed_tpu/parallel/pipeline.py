@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..telemetry.trace import device_span
+from ..utils import compat
 
 # Stage boundaries are INSIDE the compiled scan, where host spans cannot
 # measure anything — device_span (jax.named_scope) stamps the stage /
@@ -43,7 +44,7 @@ from ..telemetry.trace import device_span
 
 def _pvary(x, axis):
     return jax.tree_util.tree_map(
-        lambda l: lax.pcast(l, (axis,), to="varying"), x)
+        lambda l: compat.pcast_varying(l, axis), x)
 
 
 def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
@@ -60,7 +61,7 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
     - ``stage_fn(stage_params_local, h) -> h``: one stage's layer sub-stack.
     - ``loss_fn(shared, h, mb) -> scalar``: final-norm + head + loss.
     """
-    S = lax.axis_size(axis)
+    S = compat.axis_size(axis)
     sid = lax.axis_index(axis)
     leaves = jax.tree_util.tree_leaves(microbatches)
     M = leaves[0].shape[0]
@@ -75,7 +76,11 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
     mb0 = pick_mb(jnp.int32(0))
     h_shape = jax.eval_shape(lambda: embed_fn(shared_params, mb0))
     x0 = _pvary(jnp.zeros(h_shape.shape, h_shape.dtype), axis)
-    loss0 = _pvary(jnp.zeros((), jnp.float32), axis)
+    # rank-1, not rank-0: legacy (0.4.x) shard_map mis-names SCALAR
+    # residuals when jit partial-eval splits the body for autodiff
+    # (names {0: axes} on a float32[] trips _check_names in the
+    # transpose); a (1,) accumulator sidesteps it at zero cost
+    loss0 = _pvary(jnp.zeros((1,), jnp.float32), axis)
 
     def tick(carry, t):
         x_buf, loss_acc = carry
@@ -98,8 +103,8 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
                                 jnp.logical_and(out_t >= 0, out_t < M))
         with device_span("pipe_loss_head"):
             loss_acc = loss_acc + lax.cond(
-                valid, lambda: loss_fn(shared_params, y, mb_out),
-                lambda: jnp.float32(0.0))
+                valid, lambda: loss_fn(shared_params, y, mb_out).reshape(1),
+                lambda: jnp.zeros((1,), jnp.float32))
         with device_span("pipe_ring"):
             x_next = lax.ppermute(y, axis,
                                   [(i, (i + 1) % S) for i in range(S)])
@@ -107,7 +112,7 @@ def gpipe_loss(shared_params: Any, stage_params: Any, microbatches: Any,
 
     (x_fin, loss_sum), _ = lax.scan(tick, (x0, loss0), jnp.arange(T))
     # only the last stage accumulated real losses; share with the ring
-    return lax.psum(loss_sum, axis) / M
+    return lax.psum(loss_sum, axis)[0] / M
 
 
 def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
@@ -141,7 +146,7 @@ def onef1b_loss_and_grads(shared_params, stage_params, microbatches, scale,
     over the ring (tied-weight sync of reference ``pipe/module.py:419``),
     stage grads local to each stage.
     """
-    S = lax.axis_size(axis)
+    S = compat.axis_size(axis)
     sid = lax.axis_index(axis)
     leaves = jax.tree_util.tree_leaves(microbatches)
     M = leaves[0].shape[0]
@@ -277,7 +282,7 @@ def interleaved_spmd_grads(mesh, shared_params, stage_params, microbatches,
     ``pre_permuted=False`` keeps the standalone-call convenience: params
     arrive in global layer order and the permutation (a per-call
     all-to-all of the stack) happens here."""
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as Pspec
 
     S = mesh.shape[axis]
@@ -307,7 +312,7 @@ def onef1b_spmd_grads(mesh, shared_params, stage_params, microbatches, scale,
     """shard_map wrapper for :func:`onef1b_loss_and_grads` — manual only
     over ``pp`` like :func:`pipeline_spmd_loss`, so ZeRO/TP/DP sharding
     inside each stage stays automatic."""
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     fn = functools.partial(onef1b_loss_and_grads, embed_fn=embed_fn,
@@ -363,7 +368,7 @@ def interleaved_1f1b_loss_and_grads(shared_params, stage_params,
     Returns ``(loss, shared_grads, stage_grads)`` with stage grads in the
     same local-slot layout.
     """
-    S = lax.axis_size(axis)
+    S = compat.axis_size(axis)
     sid = lax.axis_index(axis)
     V = virtual_stages
     P = S * V
@@ -514,7 +519,7 @@ def pipeline_spmd_loss(mesh, shared_params, stage_params, microbatches, *,
     ``pp`` — every other mesh axis stays automatic so ZeRO/TP/DP sharding
     composes (XLA keeps handling those collectives inside each stage).
     """
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     fn = functools.partial(gpipe_loss, embed_fn=embed_fn, stage_fn=stage_fn,

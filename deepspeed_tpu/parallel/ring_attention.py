@@ -22,6 +22,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import compat
 from jax import lax
 
 
@@ -58,7 +60,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     ``[i·S_local, (i+1)·S_local)``).
     """
     B, S, H, D = q.shape
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     if scale is None:
         scale = D ** -0.5
@@ -67,7 +69,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     q32 = q
     # initial accumulators are constants; mark them device-varying so the
     # scan carry type is stable under shard_map's varying-axis typing
-    pvary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    pvary = lambda x: compat.pcast_varying(x, axis_name)
     acc0 = pvary(jnp.zeros((B, S, H, D), jnp.float32))
     m0 = pvary(jnp.full((B, H, S), -jnp.inf, jnp.float32))
     l0 = pvary(jnp.zeros((B, H, S), jnp.float32))
@@ -117,7 +119,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     Requires ``H % axis_size == 0``.  Must run inside ``shard_map``.
     """
     B, S, H, D = q.shape
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     if H % n != 0:
         raise ValueError(f"heads {H} not divisible by sp axis size {n}")
 
@@ -157,12 +159,12 @@ def ring_attention_flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
     from ..ops.pallas.flash_attention import flash_attention_with_lse
 
     B, S, H, D = q.shape
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     if scale is None:
         scale = D ** -0.5
 
-    pvary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    pvary = lambda x: compat.pcast_varying(x, axis_name)
 
     def block(q, k_blk, v_blk, kv_idx):
         def full(_):

@@ -781,7 +781,7 @@ class Engine:
         dense (V, E) psum.  Comm volume per listed leaf drops from V·E to
         W·tokens·(E+1).  Exact while a shard's touched rows ≤ its token
         count — true by construction for embedding lookups."""
-        from jax import shard_map
+        from ..utils.compat import shard_map
 
         from ..ops import sparse_grads as sg
 

@@ -155,7 +155,8 @@ def step_factory(mesh, loss_fn, lr_fn, *, b1: float, b2: float, eps: float,
         perw_spec = jax.tree_util.tree_map(
             lambda p: P(DATA_AXES, *([None] * np.ndim(p))), params)
         repl = jax.tree_util.tree_map(lambda p: P(), params)
-        fn = jax.shard_map(
+        from ..utils.compat import shard_map
+        fn = shard_map(
             local, mesh=mesh,
             in_specs=(P(), P(), perw_spec, repl, perw_spec, b_specs,
                       P(), P()),

@@ -1,0 +1,137 @@
+"""Itemize the decode tick against the weight-bandwidth floor.
+
+BENCH_NORTHSTAR round-5 measured ~1.4 ms/tick of FIXED non-weight cost
+(~0.05 ms/layer of XLA op overhead + head + sampler) shared by the fp and
+int8 variants — the gap the fused decode megakernels
+(``ops/pallas/decode_layer.py``) attack.  This probe measures it e2e
+(repo law: only e2e sweeps decide — isolated kernel probes mislead):
+
+- steady-state decode tick time through ``ContinuousBatcher`` with
+  ``decode_fused`` OFF vs ON (same params, same slots);
+- the weight-bandwidth floor: decode-path weight bytes per tick divided
+  by the chip's HBM bandwidth — the physics a perfect megakernel cannot
+  beat; everything above the floor is overhead;
+- the per-kernel telemetry counters, confirming which path actually ran.
+
+Run (TPU):   python scripts/probe_decode_overhead.py [fp|int8] [preset]
+Run (CPU):   JAX_PLATFORMS=cpu python scripts/probe_decode_overhead.py \\
+                 fp tiny --ticks 4    # interpret-mode kernels, smoke only
+                                      # (CPU timings are NOT a sweep)
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ".")
+
+import jax                      # noqa: E402
+import jax.numpy as jnp         # noqa: E402
+import numpy as np              # noqa: E402
+
+import deepspeed_tpu            # noqa: E402
+from deepspeed_tpu.inference.serving import ContinuousBatcher    # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config  # noqa: E402
+from deepspeed_tpu.telemetry import registry as telemetry_registry  # noqa: E402
+
+# a decode-fused-friendly tiny config (dims lane-aligned, unlike gpt2-tiny)
+TINY = dict(vocab_size=512, n_positions=128, n_embd=128, n_layer=2,
+            n_head=2)
+
+
+def build_batcher(preset: str, quant: dict, fused: bool, slots: int):
+    if preset == "tiny":
+        cfg = gpt2_config("gpt2-125m", **TINY)
+    else:
+        cfg = gpt2_config(preset)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: getattr(x, "value", x),
+        model.init(jax.random.PRNGKey(0),
+                   np.zeros((1, 8), np.int32))["params"],
+        is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
+    eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                       quant=quant, decode_fused=fused)
+    return eng, ContinuousBatcher(eng, n_slots=slots)
+
+
+def weight_bytes_per_tick(eng) -> int:
+    """Bytes of HBM-resident weights the decode tick must stream: every
+    param leaf once (embeddings are touched per row; counting them whole
+    is a <2% overestimate at serving shapes and keeps the floor honest)."""
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(eng.params))
+
+
+def time_ticks(b, slots: int, plen: int, gen_limit: int, window: int,
+               reps: int):
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 500, size=(plen,)).astype(np.int32)
+               for _ in range(slots)]
+    b.run(prompts, max_new_tokens=2, ticks=4)        # warm prefill+decode
+    for p in prompts:                                # pin every slot busy
+        b.submit(p, max_new_tokens=gen_limit - plen - 2)
+    b.step(ticks=1)
+    f = b._multi_step(window, True)
+    args = lambda: (b.engine.params, b._cache, b._token, b._pos,  # noqa: E731
+                    jnp.arange(slots), b._temp, b._top_p, b._rep, b._seen,
+                    b._done, jnp.int32(b._tick_no), jnp.int32(-1),
+                    jnp.int32(0))
+    jax.block_until_ready(f(*args()))                # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args())
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / (reps * window)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", nargs="?", default="fp", choices=["fp", "int8"])
+    ap.add_argument("preset", nargs="?", default="gpt2-760m")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--plen", type=int, default=32)
+    ap.add_argument("--ticks", type=int, default=16,
+                    help="window length timed (pow2)")
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--hbm-gbps", type=float, default=819.0,
+                    help="chip HBM bandwidth for the floor (GB/s)")
+    args = ap.parse_args()
+    quant = {"enabled": True, "bits": 8} if args.mode == "int8" else {}
+
+    rows = []
+    for fused in (False, True):
+        eng, b = build_batcher(args.preset, quant, fused, args.slots)
+        per_tick = time_ticks(b, args.slots, args.plen, eng._gen_limit,
+                              args.ticks, args.reps)
+        wb = weight_bytes_per_tick(eng)
+        floor = wb / (args.hbm_gbps * 1e9)
+        rows.append((fused, per_tick, wb, floor))
+        del eng, b
+
+    print(f"\npreset={args.preset} mode={args.mode} slots={args.slots} "
+          f"window={args.ticks} backend={jax.devices()[0].platform}")
+    print(f"{'path':<10} {'ms/tick':>9} {'floor ms':>9} {'overhead ms':>12} "
+          f"{'tok/s (pool)':>13}")
+    for fused, per_tick, wb, floor in rows:
+        name = "fused" if fused else "xla"
+        over = per_tick - floor
+        print(f"{name:<10} {per_tick * 1e3:>9.3f} {floor * 1e3:>9.3f} "
+              f"{over * 1e3:>12.3f} {args.slots / per_tick:>13.1f}")
+    base, fused_t = rows[0][1], rows[1][1]
+    print(f"fused speedup: {base / fused_t:.3f}x  "
+          f"(weight floor {rows[0][3]*1e3:.3f} ms = "
+          f"{rows[0][2] / 1e6:.1f} MB/tick @ {args.hbm_gbps:.0f} GB/s)")
+
+    snap = telemetry_registry.get_registry().snapshot()
+    for key in ("decode_fused_qkv_traces_total",
+                "decode_fused_post_attn_traces_total",
+                "decode_fused_fallback_total"):
+        if key in snap:
+            vals = [s["value"] for s in snap[key]["samples"]] or [0.0]
+            print(f"{key}: {vals[0]:.0f}")
+
+
+if __name__ == "__main__":
+    main()

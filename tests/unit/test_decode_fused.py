@@ -1,0 +1,216 @@
+"""Fused decode-tick megakernel tests (ops/pallas/decode_layer.py).
+
+Kernel-level parity (interpret-mode kernels vs the unfused XLA op chain,
+fp32/bf16/W8A16) plus the dispatch guards.  The heavier model-level and
+end-to-end tests (batcher on the CPU mesh, probe smoke) live in
+``test_zdecode_fused_e2e.py``, sorted late so the fixed tier-1 time
+window keeps its breadth — an uncapped suite runs both."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.models import common as model_common
+from deepspeed_tpu.ops.pallas.decode_layer import (
+    fused_norm_proj, fused_post_attn, norm_proj_supported,
+    post_attn_supported)
+from deepspeed_tpu.ops.w8 import quantize_weight, w8a16_matmul
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _ln(x, s, b, eps=1e-5):
+    return model_common.layer_norm(x, s, b, eps)
+
+
+# ---------------- kernel-level parity (interpret mode) ----------------
+
+def test_norm_proj_parity():
+    rng = np.random.default_rng(0)
+    M, E, N = 4, 128, 384
+    x = jnp.asarray(rng.standard_normal((M, E)), jnp.float32)
+    ns = jnp.asarray(rng.standard_normal(E) * 0.1 + 1, jnp.float32)
+    nb = jnp.asarray(rng.standard_normal(E) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, N)) * 0.02, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(N) * 0.02, jnp.float32)
+
+    ref = jnp.dot(_ln(x, ns, nb), w) + b
+    out = fused_norm_proj(x, ns, nb, w, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # RMSNorm / no-bias (the llama projection shape)
+    ref = jnp.dot(model_common.rms_norm(x, ns, 1e-5), w)
+    out = fused_norm_proj(x, ns, None, w, None, rms=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # W8A16: dequant inside the fused contraction == XLA grouped einsum
+    codes, scale = quantize_weight(w, group=128)
+    ref = w8a16_matmul(_ln(x, ns, nb), codes, scale) + b
+    out = fused_norm_proj(x, ns, nb, (codes, scale), b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # slot-vmapped axis folds into the row dim (the serving hot loop)
+    ref = jnp.dot(_ln(x, ns, nb), w) + b
+    out = jax.vmap(lambda xx: fused_norm_proj(xx, ns, nb, w, b,
+                                              interpret=True))(
+        x.reshape(M, 1, 1, E))
+    np.testing.assert_allclose(np.asarray(out).reshape(M, N),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_norm_proj_bf16():
+    rng = np.random.default_rng(1)
+    M, E, N = 3, 128, 256
+    x = jnp.asarray(rng.standard_normal((M, E)), jnp.bfloat16)
+    ns = jnp.ones((E,), jnp.float32)
+    nb = jnp.zeros((E,), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, N)) * 0.02, jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal(N) * 0.02, jnp.bfloat16)
+    ref = jnp.dot(_ln(x, ns, nb), w) + b
+    out = fused_norm_proj(x, ns, nb, w, b, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_post_attn_parity():
+    import flax.linen as nn
+
+    rng = np.random.default_rng(2)
+    M, E, F = 4, 128, 512
+    f32 = lambda shape, s=0.02: jnp.asarray(          # noqa: E731
+        rng.standard_normal(shape) * s, jnp.float32)
+    y, x = f32((M, E), 1.0), f32((M, E), 1.0)
+    wo, bo = f32((E, E)), f32(E)
+    ns = jnp.asarray(rng.standard_normal(E) * 0.1 + 1, jnp.float32)
+    nb = f32(E)
+    w1, b1, w2, b2 = f32((E, F)), f32(F), f32((F, E)), f32(E)
+
+    r1 = x + (jnp.dot(y, wo) + bo)
+    ref = r1 + jnp.dot(nn.gelu(jnp.dot(_ln(r1, ns, nb), w1) + b1,
+                               approximate=True), w2) + b2
+    out = fused_post_attn(y, x, wo, bo, ns, nb, (w1, b1, w2, b2),
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # NeoX shape: parallel residual + exact gelu
+    ref = r1 + jnp.dot(nn.gelu(jnp.dot(_ln(x, ns, nb), w1) + b1,
+                               approximate=False), w2) + b2
+    out = fused_post_attn(y, x, wo, bo, ns, nb, (w1, b1, w2, b2),
+                          exact_gelu=True, parallel_residual=True,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # LLaMA shape: SwiGLU + RMSNorm, no biases
+    wg, wu, wd = f32((E, F)), f32((E, F)), f32((F, E))
+    r1s = x + jnp.dot(y, wo)
+    hs = model_common.rms_norm(r1s, ns, 1e-5)
+    ref = r1s + jnp.dot(nn.silu(jnp.dot(hs, wg)) * jnp.dot(hs, wu), wd)
+    out = fused_post_attn(y, x, wo, None, ns, None, (wg, wu, wd),
+                          swiglu=True, rms=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # W8A16 everywhere (o-proj + both MLP panels)
+    co, so = quantize_weight(wo, 128)
+    c1, s1 = quantize_weight(w1, 128)
+    c2, s2 = quantize_weight(w2, 128)
+    r1q = x + (w8a16_matmul(y, co, so) + bo)
+    ref = r1q + w8a16_matmul(
+        nn.gelu(w8a16_matmul(_ln(r1q, ns, nb), c1, s1) + b1,
+                approximate=True), c2, s2) + b2
+    out = fused_post_attn(y, x, (co, so), bo, ns, nb,
+                          ((c1, s1), b1, (c2, s2), b2), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_vmap_fold_past_row_guard_uses_reference():
+    """A slot-vmapped fold larger than the row guard (the per-slot trace
+    only validated M=1) must compute the reference chain instead of
+    launching an unguarded kernel — and stay exact."""
+    from deepspeed_tpu.ops.pallas.decode_layer import _MAX_ROWS
+
+    rng = np.random.default_rng(5)
+    S, E, N, F = _MAX_ROWS + 16, 128, 256, 256
+    x = jnp.asarray(rng.standard_normal((S, E)), jnp.float32)
+    ns = jnp.asarray(rng.standard_normal(E) * 0.1 + 1, jnp.float32)
+    nb = jnp.asarray(rng.standard_normal(E) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, N)) * 0.02, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(N) * 0.02, jnp.float32)
+    ref = jnp.dot(_ln(x, ns, nb), w) + b
+    out = jax.vmap(lambda xx: fused_norm_proj(xx, ns, nb, w, b,
+                                              interpret=True))(
+        x.reshape(S, 1, E))
+    np.testing.assert_allclose(np.asarray(out).reshape(S, N),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    import flax.linen as nn
+
+    y = jnp.asarray(rng.standard_normal((S, E)), jnp.float32)
+    wo = jnp.asarray(rng.standard_normal((E, E)) * 0.02, jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, F)) * 0.02, jnp.float32)
+    b1 = jnp.asarray(rng.standard_normal(F) * 0.02, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((F, E)) * 0.02, jnp.float32)
+    b2 = jnp.asarray(rng.standard_normal(E) * 0.02, jnp.float32)
+    r1 = x + jnp.dot(y, wo)
+    refB = r1 + jnp.dot(nn.gelu(jnp.dot(_ln(r1, ns, nb), w1) + b1,
+                                approximate=True), w2) + b2
+    outB = jax.vmap(lambda yy, xx: fused_post_attn(
+        yy, xx, wo, None, ns, nb, (w1, b1, w2, b2), interpret=True))(
+        y.reshape(S, 1, E), x.reshape(S, 1, E))
+    np.testing.assert_allclose(np.asarray(outB).reshape(S, E),
+                               np.asarray(refB), rtol=1e-5, atol=1e-5)
+
+
+def test_supported_predicates():
+    # lane-misaligned dims and oversized rows refuse
+    assert norm_proj_supported(4, 128, 384, 4, False)
+    assert not norm_proj_supported(4, 96, 384, 4, False)
+    assert not norm_proj_supported(4, 128, 200, 4, False)
+    assert not norm_proj_supported(128, 128, 384, 4, False)
+    assert post_attn_supported(4, 128, 512, 4, False)
+    assert not post_attn_supported(4, 96, 512, 4, False)
+    # a 7B-class o-proj panel does not fit the VMEM budget at bf16
+    assert not post_attn_supported(4, 4096, 11008, 2, False)
+
+
+def test_sharding_mesh_refuses():
+    """tp splits the weight panels the kernels assume whole: a tp>1 mesh
+    must keep the XLA chain (data-only meshes are fine — serving state is
+    replicated across them)."""
+    from deepspeed_tpu.comm.mesh import build_mesh
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config(n_embd=128, n_head=2, decode=True, decode_fused=True)
+    mesh_mod.set_mesh(build_mesh({"tp": 2, "dp": -1}))
+    assert model_common.decode_fused_plan(cfg, 2, 128, (384,), 512) is None
+    mesh_mod.set_mesh(build_mesh({"dp": -1}))
+    assert model_common.decode_fused_plan(cfg, 2, 128, (384,), 512) \
+        is not None
+
+
+def test_env_override_forces_off(monkeypatch):
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config(decode=True, decode_fused=True)
+    monkeypatch.setenv(model_common.DECODE_FUSED_ENV, "0")
+    assert model_common.decode_fused_mode(cfg) is None
+    monkeypatch.setenv(model_common.DECODE_FUSED_ENV, "1")
+    assert model_common.decode_fused_mode(
+        dataclasses.replace(cfg, decode_fused=False)) is not None

@@ -34,7 +34,7 @@ def test_mesh_validation():
 
 
 def test_shard_map_collectives():
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     mesh = build_mesh({"dp": 4, "tp": 2})
     x = jnp.arange(8.0)
@@ -52,7 +52,7 @@ def test_shard_map_collectives():
 
 
 def test_all_gather_reduce_scatter_roundtrip():
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     mesh = build_mesh({"dp": 8})
     x = jnp.arange(16.0)
@@ -69,7 +69,7 @@ def test_all_gather_reduce_scatter_roundtrip():
 
 
 def test_send_recv_shift_ring():
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     mesh = build_mesh({"dp": 8})
     x = jnp.arange(8.0)
@@ -83,7 +83,7 @@ def test_send_recv_shift_ring():
 
 
 def test_all_to_all():
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     mesh = build_mesh({"ep": 4})
     # each rank holds (4, 2): all_to_all transposes rank<->dim0 blocks
@@ -100,7 +100,7 @@ def test_all_to_all():
 
 
 def test_broadcast_along_axis():
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     mesh = build_mesh({"dp": 8})
     x = jnp.arange(8.0)
@@ -208,7 +208,7 @@ def test_same_across_ranks_invariant():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu import comm
@@ -245,7 +245,7 @@ def test_assert_same_across_processes_single_is_noop():
 def test_same_across_ranks_nan_consistent():
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu import comm

@@ -55,7 +55,8 @@ def test_packed_allreduce_matches_unpacked():
             tot, ne = fn(x[0], e[0], ("dp",))
             return tot, ne[None]
 
-        return jax.shard_map(
+        from deepspeed_tpu.utils.compat import shard_map
+        return shard_map(
             local, mesh=mesh, in_specs=(P("dp"), P("dp")),
             out_specs=(P(), P("dp")), check_vma=False)(x, e)
 

@@ -75,7 +75,7 @@ def test_onebit_compress_error_feedback():
 
 
 def test_compressed_all_reduce_under_shard_map():
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu.comm.mesh import build_mesh

@@ -6,7 +6,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_mod
@@ -84,7 +84,7 @@ def test_ring_flash_matches_full_attention():
     from functools import partial
 
     import numpy as np
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu.comm import mesh as mesh_mod
@@ -126,7 +126,7 @@ def test_ring_flash_non_causal():
     from functools import partial
 
     import numpy as np
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu.comm import mesh as mesh_mod
@@ -180,7 +180,7 @@ def test_ring_flash_with_dp_and_tp_axes():
     from functools import partial
 
     import numpy as np
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     from deepspeed_tpu.comm import mesh as mesh_mod
     from deepspeed_tpu.ops.attention import _jnp_attention, sp_flash_spec
@@ -223,7 +223,7 @@ def test_ulysses_flash_with_dp_and_tp_axes():
     from functools import partial
 
     import numpy as np
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     from deepspeed_tpu.comm import mesh as mesh_mod
     from deepspeed_tpu.ops.attention import _jnp_attention, sp_flash_spec

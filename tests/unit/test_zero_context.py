@@ -130,7 +130,7 @@ def test_sparse_all_reduce_matches_psum():
         grads[w, rows] = rng.normal(size=(4, 4))
     g = jnp.asarray(grads)
 
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
 
     f = shard_map(
         lambda x: sparse_all_reduce(x[0], "dp", max_rows=4),
