@@ -230,12 +230,11 @@ class Autotuner:
             costs = dict(costs or {})
             # memory_analysis/cost_analysis report the PER-DEVICE
             # (post-SPMD-partitioning) program — compare against one
-            # chip's HBM directly, no further division
-            mem = compiled.memory_analysis()
-            peak = float(getattr(mem, "temp_size_in_bytes", 0)
-                         + getattr(mem, "argument_size_in_bytes", 0)
-                         + getattr(mem, "output_size_in_bytes", 0)) \
-                if mem is not None else float("nan")
+            # chip's HBM directly, no further division; the normalizer
+            # is shared with the profiler and the scrapeable HBM gauges
+            from ..telemetry import memory as telemetry_memory
+
+            peak = telemetry_memory.peak_bytes(compiled)
             result.flops = float(costs.get("flops", 0.0))
             result.bytes_accessed = float(costs.get("bytes accessed", 0.0))
             result.peak_memory_bytes = peak

@@ -198,10 +198,28 @@ class InferenceEngine:
         self.mesh = mesh
 
         self.params = None
+        # /statusz section (weakly held — see telemetry/exporter.py)
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_owner(
+            "inference", self, "_telemetry_status")
         if params is not None:
             self.load_params(params)
         elif self.config.checkpoint:
             self.load_checkpoint(self.config.checkpoint)
+
+    def _telemetry_status(self) -> dict:
+        # cached by load_params: a 1/s statusz scrape must not re-walk
+        # a large param tree on the HTTP thread every request
+        return {
+            "model": type(self.model).__name__,
+            "params_m": round(getattr(self, "_n_params", 0) / 1e6, 2),
+            "loaded": self.params is not None,
+            "gen_limit": int(self._gen_limit),
+            "mp_size": int(self.mesh.shape.get("tp", 1)),
+            "w8": self._w8,
+            "dtype": str(self.model_cfg.dtype),
+        }
 
     # ------------------------------------------------------------------
     def _param_shardings(self, abstract_boxed):
@@ -272,8 +290,24 @@ class InferenceEngine:
         self.params = jax.tree_util.tree_map_with_path(
             _put, unboxed, shardings)
         n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+        self._n_params = n
         log_dist(f"inference params loaded: {n/1e6:.1f}M, mp={self.mesh.shape['tp']}",
                  ranks=[0])
+        try:
+            # per-device resident bytes (TP splits the tree): the static
+            # half of the serving OOM-headroom picture — KV caches and
+            # activations come on top (live_hbm_bytes covers those)
+            from ..telemetry import memory as telemetry_memory
+            from ..telemetry import registry as telemetry_registry
+
+            per_dev, _ = telemetry_memory.per_device_shard_bytes(
+                jax.tree_util.tree_leaves(self.params))
+            telemetry_registry.gauge(
+                "hbm_params_bytes",
+                "max per-device bytes resident for inference params"
+            ).set(float(max(per_dev.values(), default=0)))
+        except Exception:
+            pass
         return self
 
     def load_checkpoint(self, ckpt_dir: str, tag: Optional[str] = None):

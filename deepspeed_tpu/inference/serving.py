@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..telemetry import recompile, registry as telemetry_registry, trace
+from ..telemetry import (goodput, memory as telemetry_memory, recompile,
+                         registry as telemetry_registry, trace)
 from .engine import InferenceEngine, _sample
 
 
@@ -143,6 +144,12 @@ class ContinuousBatcher:
             "serving_active_slots", "occupied decode slots")
         self._m_queue = telemetry_registry.gauge(
             "serving_queue_depth", "queued + parked requests")
+        # /statusz section (weakly held: a dropped batcher must not be
+        # pinned — it holds the engine and therefore the params in HBM)
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_owner(
+            "serving", self, "_telemetry_status")
 
         decode_model = engine._decode_model
         top_k_static = self.top_k
@@ -339,13 +346,38 @@ class ContinuousBatcher:
                                    temperature, top_p, repetition_penalty))
         self._t_submit[uid] = time.perf_counter()
         self._m_submitted.inc()
-        self._m_queue.set(len(self._queue) + len(self._parked))
+        self._update_occupancy_gauges()
         return uid
 
     @property
     def pending(self) -> int:
         return (len(self._queue) + len(self._parked)
                 + sum(s is not None for s in self._slots))
+
+    def _update_occupancy_gauges(self) -> None:
+        """Refresh ``serving_queue_depth``/``serving_active_slots``.
+
+        Called from EVERY path that moves a request between queue, parked
+        set, slots, and finished (submit / prefill-park / place / retire /
+        unslotted-finish) — not just ``submit`` — so a scrape between
+        submits never reads a stale depth."""
+        self._m_queue.set(len(self._queue) + len(self._parked))
+        self._m_active.set(sum(s is not None for s in self._slots))
+
+    def _telemetry_status(self) -> dict:
+        """The ``/statusz`` ``serving`` section (telemetry/exporter.py)."""
+        return {
+            "n_slots": self.n_slots,
+            "active_slots": sum(s is not None for s in self._slots),
+            "queued": len(self._queue),
+            "parked": len(self._parked),
+            "pending": self.pending,
+            "ticks": self._tick_no,
+            "submitted": self._next_uid,
+            "finished_buffered": len(self._finished),
+            "prefill_ahead": self.prefill_ahead,
+            "gen_limit": int(self.engine._gen_limit),
+        }
 
     # ------------------------------------------------------------------
     def _prefill(self, ids):
@@ -451,6 +483,7 @@ class ContinuousBatcher:
                 # row on device (no eager per-row dispatches here)
                 self._parked.append(
                     (req, cacheB, row, firstB, seen1B, first_host))
+        self._update_occupancy_gauges()
 
     def _record_latency(self, uid: int) -> None:
         """Collapse a retired request's in-flight timestamps into the
@@ -471,6 +504,7 @@ class ContinuousBatcher:
         self._finished[req.uid] = np.concatenate(
             [req.prompt, np.asarray(emitted, np.int32)])
         self._record_latency(req.uid)
+        self._update_occupancy_gauges()
 
     def _admit(self):
         """Place parked (already-prefilled) requests into free slots;
@@ -491,6 +525,7 @@ class ContinuousBatcher:
                     req.temperature, req.top_p, req.repetition_penalty)
             self._slots[i] = _Active(req, [first_host])
         self._shrink_parked()
+        self._update_occupancy_gauges()
 
     def _shrink_parked(self):
         """Release B-row prefill buffers that only one parked row still
@@ -520,6 +555,7 @@ class ContinuousBatcher:
         self._slots[i] = None
         self._done, self._pos, self._cache = self._retire_fn(
             self._done, self._pos, self._cache, i)
+        self._update_occupancy_gauges()
 
     # ------------------------------------------------------------------
     def step(self, ticks: int = 1) -> Dict[int, np.ndarray]:
@@ -552,8 +588,7 @@ class ContinuousBatcher:
                     self._prefill_batch(
                         self.prefill_ahead - len(self._parked))
             active = [a for a in self._slots if a is not None]
-            self._m_active.set(len(active))
-            self._m_queue.set(len(self._queue) + len(self._parked))
+            self._update_occupancy_gauges()
             if not active:
                 break
             sub = remaining
@@ -601,6 +636,7 @@ class ContinuousBatcher:
                             len(act.emitted) >= act.req.max_new_tokens:
                         self._retire(i)
             remaining -= int(sub)
+        goodput.note_step("serving")   # /healthz last-step age
         new = {u: self._finished[u] for u in self._finished if u not in before}
         return new
 
@@ -634,11 +670,16 @@ class ContinuousBatcher:
         the measured first-token path)."""
         s = 1
         while s <= int(ticks):
-            self._multi_step(s, greedy).lower(
+            compiled = self._multi_step(s, greedy).lower(
                 self.engine.params, self._cache, self._token, self._pos,
                 jnp.arange(self.n_slots), self._temp, self._top_p,
                 self._rep, self._seen, self._done, jnp.int32(0),
                 jnp.int32(self.eos), jnp.int32(self.pad)).compile()
+            # the AOT compile is the one place a Compiled handle exists:
+            # publish its per-device HBM breakdown (telemetry/memory.py)
+            telemetry_memory.record_compiled(
+                compiled,
+                site=f"serving.decode[{s}{'g' if greedy else 's'}]")
             s <<= 1
         if admission:
             self._warmup_admission()
@@ -660,17 +701,23 @@ class ContinuousBatcher:
             seen = sds((B, 1, V), jnp.bool_)
             uids = sds((B,), jnp.int32)
             f32 = sds((B,), jnp.float32)
-            self._first_token_batch.lower(
-                logits, seen, uids, f32, f32, f32).compile()
+            telemetry_memory.record_compiled(
+                self._first_token_batch.lower(
+                    logits, seen, uids, f32, f32, f32).compile(),
+                site=f"serving.first_token[{B}]")
             cacheB = jax.eval_shape(lambda: self.engine.init_cache(B))
             firstB = sds((B, 1), jnp.int32)
-            self._place_fn.lower(
-                self._cache, self._token, self._pos, self._temp,
-                self._top_p, self._rep, self._seen, self._done,
-                cacheB, firstB, seen, 0, 1, 0, 0.0, 1.0, 1.0).compile()
+            telemetry_memory.record_compiled(
+                self._place_fn.lower(
+                    self._cache, self._token, self._pos, self._temp,
+                    self._top_p, self._rep, self._seen, self._done,
+                    cacheB, firstB, seen, 0, 1, 0, 0.0, 1.0, 1.0).compile(),
+                site=f"serving.place[{B}]")
             if B > 1:
-                self._extract_row_fn.lower(
-                    cacheB, firstB, seen, 0).compile()
+                telemetry_memory.record_compiled(
+                    self._extract_row_fn.lower(
+                        cacheB, firstB, seen, 0).compile(),
+                    site=f"serving.extract_row[{B}]")
 
     # ------------------------------------------------------------------
     def reset_latency_stats(self) -> None:

@@ -94,7 +94,14 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics_dir", type=str, default=None,
                    help="directory for per-rank telemetry dumps: each "
                         "worker writes metrics_rank<k>.json (a registry "
-                        "snapshot, see telemetry/registry.py) on exit")
+                        "snapshot, see telemetry/registry.py) on exit or "
+                        "SIGTERM, plus flight_<k>.json crash forensics "
+                        "(telemetry/flightrec.py)")
+    p.add_argument("--telemetry_port", type=int, default=None,
+                   help="base port for the per-rank telemetry HTTP "
+                        "exporter (/metrics /healthz /statusz, see "
+                        "telemetry/exporter.py): rank k serves on port+k; "
+                        "0 = OS-assigned port per rank; omit = no server")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p
@@ -118,29 +125,46 @@ class HeartbeatMonitor:
         # NTP step or worker/launcher mtime skew can't fake a dead worker.
         self._seen: dict = {}
 
-    def stale(self) -> list[int]:
+    def _observe(self) -> float:
+        """Fold each rank's current heartbeat mtime into ``_seen`` (the
+        ONE observation walk both ``stale`` and ``ages`` derive from —
+        neither depends on the other being called first); returns now.
+
+        A first sighting counts as fresh: mtime is never used as a
+        clock (only compared for equality), so NTP steps or
+        launcher/worker mtime skew can't fake a dead worker.  A worker
+        that beat once and died pre-launch costs one extra timeout to
+        flag — the safe side of that trade."""
         now = time.monotonic()
-        bad = []
         for rank, path in enumerate(self.files):
             try:
                 mtime = os.path.getmtime(path)
             except OSError:                      # not yet written
-                if now - self.t0 > self.grace:
-                    bad.append(rank)
                 continue
             prev = self._seen.get(rank)
-            if prev is None:
-                # first sighting counts as fresh: mtime is never used as a
-                # clock (only compared for equality), so NTP steps or
-                # launcher/worker mtime skew can't fake a dead worker.  A
-                # worker that beat once and died pre-launch costs one extra
-                # timeout to flag — the safe side of that trade.
-                self._seen[rank] = (mtime, now)
-            elif prev[0] != mtime:
+            if prev is None or prev[0] != mtime:
                 self._seen[rank] = (mtime, now)  # fresh beat observed
-            if now - self._seen[rank][1] > self.timeout:
+        return now
+
+    def stale(self) -> list[int]:
+        now = self._observe()
+        bad = []
+        for rank in range(len(self.files)):
+            prev = self._seen.get(rank)
+            if prev is None:
+                if now - self.t0 > self.grace:
+                    bad.append(rank)
+            elif now - prev[1] > self.timeout:
                 bad.append(rank)
         return bad
+
+    def ages(self) -> "list[Optional[float]]":
+        """Seconds since each rank's last OBSERVED beat (None = no beat
+        seen yet) — the launcher-side straggler report: a rank whose age
+        creeps toward the timeout is visible BEFORE it is declared dead."""
+        now = self._observe()
+        return [now - self._seen[r][1] if r in self._seen else None
+                for r in range(len(self.files))]
 
 
 _TERM_GRACE_S = 10.0    # SIGTERM → SIGKILL escalation window (lets the
@@ -182,6 +206,10 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
                    DSTPU_PROCESS_ID=str(pid_idx))
         if args.metrics_dir:
             env["DSTPU_METRICS_DIR"] = args.metrics_dir
+        if args.telemetry_port is not None:
+            # base port only: each worker offsets by its own rank
+            # (telemetry/exporter.py maybe_start)
+            env["DSTPU_TELEMETRY_PORT"] = str(args.telemetry_port)
         if hb_dir:
             hb = os.path.join(hb_dir, f"hb_{pid_idx}")
             env["DSTPU_HEARTBEAT_FILE"] = hb
@@ -201,6 +229,8 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
     prev_term = signal.signal(signal.SIGTERM, _on_signal)
     monitor = HeartbeatMonitor(hb_files, args.heartbeat_timeout) \
         if hb_files else None
+    age_report_every = max(2.0, args.heartbeat_timeout / 2)
+    last_age_report = time.monotonic()
     rc = 0
     try:
         while True:
@@ -223,6 +253,20 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
                     _reap(procs)
                     rc = 1
                     break
+                if time.monotonic() - last_age_report > age_report_every:
+                    last_age_report = time.monotonic()
+                    ages = monitor.ages()
+                    lagging = [
+                        (r, a) for r, a in enumerate(ages)
+                        if states[r] is None and a is not None
+                        and a > args.heartbeat_timeout / 2]
+                    if lagging:
+                        # a straggler is visible BEFORE it is declared dead
+                        logger.warning(
+                            "heartbeat straggler(s): " + ", ".join(
+                                f"rank {r} last beat {a:.1f}s ago"
+                                for r, a in lagging)
+                            + f" (timeout {args.heartbeat_timeout}s)")
             time.sleep(0.2)
         _reap(procs)
     finally:
@@ -245,6 +289,8 @@ def _launch_hostfile(args) -> int:
     procs = []
     metrics_env = f"DSTPU_METRICS_DIR={shlex.quote(args.metrics_dir)} " \
         if args.metrics_dir else ""
+    if args.telemetry_port is not None:
+        metrics_env += f"DSTPU_TELEMETRY_PORT={args.telemetry_port} "
     for idx, host in enumerate(host_list):
         remote_cmd = (
             f"cd {shlex.quote(os.getcwd())} && "
@@ -262,10 +308,49 @@ def _launch_hostfile(args) -> int:
     return rc
 
 
+def _report_flight_dumps(metrics_dir: Optional[str],
+                         since: Optional[float] = None) -> None:
+    """Pretty-print the most informative flight dump after a failure:
+    dead workers' SIGTERM/excepthook handlers (telemetry/flightrec.py)
+    have written their forensics by the time ``_reap`` returns, and a
+    crash dump wins over the SIGTERMed bystanders'."""
+    if not metrics_dir:
+        return
+    try:
+        from ..telemetry import flightrec
+
+        path = flightrec.newest_dump(metrics_dir, since=since)
+        if path is None:
+            logger.info(f"no flight dump found under {metrics_dir}")
+            return
+        logger.error("postmortem of the failed run:\n"
+                     + flightrec.pretty(path))
+    except Exception as e:   # forensics are best-effort, never fatal
+        logger.warning(f"could not read flight dumps in {metrics_dir}: {e!r}")
+
+
+def _disarm_own_telemetry() -> None:
+    """The launcher imports ``deepspeed_tpu``, so operator-exported
+    telemetry env vars (``DSTPU_TELEMETRY_PORT`` / ``DSTPU_METRICS_DIR``)
+    arm the launcher PROCESS too: it would squat worker rank 0's exporter
+    port and overwrite rank 0's metrics/flight dumps on exit.  Workers
+    re-arm from their own (injected) env; the execv single-process path
+    replaces this process image entirely, so disarming is always safe."""
+    try:
+        from ..telemetry import exporter, flightrec, registry
+
+        exporter.disarm()
+        flightrec.disarm()
+        registry.disarm_exit_dump()
+    except Exception:
+        pass
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     if args.user_args and args.user_args[0] == "--":
         args.user_args = args.user_args[1:]
+    _disarm_own_telemetry()
     if args.hostfile:
         return _launch_hostfile(args)
     if args.num_processes > 1 or args.heartbeat_timeout > 0 \
@@ -276,6 +361,7 @@ def main(argv=None) -> int:
         attempts = args.max_restarts + 1
         for attempt in range(attempts):
             interrupted: list = []
+            attempt_t0 = time.time()
             rc = _launch_local_procs(args, interrupted)
             if rc == 0:
                 return 0
@@ -284,6 +370,7 @@ def main(argv=None) -> int:
                 # never auto-restart over the user's intent
                 logger.info("job interrupted by operator; not restarting")
                 return rc
+            _report_flight_dumps(args.metrics_dir, since=attempt_t0)
             if attempt < attempts - 1:
                 logger.warning(f"job failed (rc={rc}); restart "
                                f"{attempt + 1}/{args.max_restarts}")
@@ -291,6 +378,8 @@ def main(argv=None) -> int:
     # single process: exec in place (the common TPU case — one proc/host)
     if args.metrics_dir:
         os.environ["DSTPU_METRICS_DIR"] = args.metrics_dir
+    if args.telemetry_port is not None:
+        os.environ["DSTPU_TELEMETRY_PORT"] = str(args.telemetry_port)
     os.execv(sys.executable, [sys.executable, args.user_script] + args.user_args)
 
 

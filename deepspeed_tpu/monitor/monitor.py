@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import csv
 import os
+import time
 from typing import Any, Optional
 
 from ..runtime.config import MonitorConfig
@@ -34,12 +35,18 @@ class _RegistryWriter:
         self._event_step = _reg.gauge(
             "monitor_event_samples", "global_samples at the latest event",
             labelnames=("label",))
+        self._last_event = _reg.gauge(
+            "monitor_last_event_unixtime",
+            "wall time of the latest write_events (exporter staleness)")
 
     def write_events(self, event_list):
+        if not event_list:
+            return   # an empty call must not refresh the staleness gauge
         for label, value, step in event_list:
             self._event.labels(label=str(label)).set(float(value))
             self._event_step.labels(label=str(label)).set(float(step))
         self._events_total.inc(len(event_list))
+        self._last_event.set(time.time())
 
     def close(self):
         pass
@@ -137,6 +144,11 @@ class MonitorMaster:
         # configured" so callers' fetch-and-write gating is unchanged
         self._registry_sink = _RegistryWriter()
         self._rank0 = self._is_rank0()
+        # /statusz section: which external writers are live on this rank
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_owner(
+            "monitor", self, "_telemetry_status")
         if not self._rank0:
             return
         if config.tensorboard.get("enabled"):
@@ -145,6 +157,11 @@ class MonitorMaster:
             self.writers.append(_WandbWriter(config.wandb))
         if config.csv_monitor.get("enabled"):
             self.writers.append(_CsvWriter(config.csv_monitor))
+
+    def _telemetry_status(self) -> dict:
+        return {"rank0": self._rank0,
+                "writers": [type(w).__name__.lstrip("_")
+                            for w in self.writers]}
 
     @staticmethod
     def _is_rank0() -> bool:

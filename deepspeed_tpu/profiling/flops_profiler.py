@@ -31,11 +31,13 @@ PEAK_TFLOPS = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
 
 
 def profile_compiled(fn: Callable, *args, static_argnums=(),
-                     lowered=None) -> dict:
+                     lowered=None, site: Optional[str] = None) -> dict:
     """Exact cost analysis of the compiled program for ``fn(*args)``.
 
     Pass ``lowered`` (a ``jax.stages.Lowered``) to reuse an existing
-    lowering — tracing a 1.5B multi-step program twice is minutes."""
+    lowering — tracing a 1.5B multi-step program twice is minutes.
+    ``site`` additionally publishes the memory breakdown as
+    ``hbm_exec_*_bytes{site=...}`` gauges (telemetry/memory.py)."""
     import jax
 
     if lowered is None:
@@ -45,17 +47,19 @@ def profile_compiled(fn: Callable, *args, static_argnums=(),
     if isinstance(costs, list):  # some backends return [dict]
         costs = costs[0] if costs else {}
     costs = dict(costs or {})
-    mem = compiled.memory_analysis()
     out = {
         "flops": float(costs.get("flops", 0.0)),
         "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
         "transcendentals": float(costs.get("transcendentals", 0.0)),
     }
+    # per-device bytes, one normalizer shared with the autotuner and the
+    # HBM gauges (telemetry/memory.py) — no private memory_analysis math
+    from ..telemetry import memory as telemetry_memory
+
+    mem = telemetry_memory.record_compiled(compiled, site=site) if site \
+        else telemetry_memory.memory_breakdown(compiled)
     if mem is not None:
-        out["peak_memory_bytes"] = float(
-            getattr(mem, "temp_size_in_bytes", 0)
-            + getattr(mem, "argument_size_in_bytes", 0)
-            + getattr(mem, "output_size_in_bytes", 0))
+        out["peak_memory_bytes"] = mem["total"]
     return out
 
 
@@ -186,7 +190,8 @@ class FlopsProfiler:
                 lowered = jax.jit(
                     lambda s, b: eng._compiled_train_step(s, b)).lower(
                     eng.state, batch)
-                self.program_costs = profile_compiled(None, lowered=lowered)
+                self.program_costs = profile_compiled(
+                    None, lowered=lowered, site="engine.train_step")
                 try:
                     self.module_flops = module_flops_breakdown(
                         None, lowered=lowered)

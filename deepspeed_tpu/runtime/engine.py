@@ -304,6 +304,19 @@ class Engine:
 
         self.monitor = MonitorMaster(self.config.monitor)
 
+        # live observability plane: /statusz section (weakly held — the
+        # provider table must not pin a dropped engine's params in HBM)
+        # + a config-identity info gauge so a scraper can tell two ranks
+        # run the same resolved config
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_owner(
+            "train", self, "_telemetry_status")
+        telemetry_registry.gauge(
+            "dstpu_config_info",
+            "resolved-config identity (value is always 1)",
+            labelnames=("digest",)).labels(digest=self.config_digest).set(1.0)
+
         # ---- aux training features (reference engine.py:331-347) ------
         self.curriculum_scheduler = None
         if self.config.curriculum_learning.get("enabled"):
@@ -740,6 +753,67 @@ class Engine:
         if self._state is None:
             raise RuntimeError("parameters not initialized; call engine.init_params(...) "
                                "or pass model_parameters/training data first")
+
+    # ------------------------------------------------------------------
+    # observability plane
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def config_digest(self) -> str:
+        """Short stable hash of the RESOLVED config — the ``/statusz``
+        identity field that lets an operator confirm every rank (and a
+        restarted job) runs the same configuration."""
+        import hashlib
+        import json
+
+        try:
+            blob = json.dumps(dataclasses.asdict(self.config),
+                              sort_keys=True, default=str)
+        except Exception:
+            blob = repr(self.config)
+        return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+    def _telemetry_status(self) -> dict:
+        """The ``/statusz`` ``train`` section (see telemetry/exporter.py)."""
+        return {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "train_batch_size": self.train_batch_size,
+            "zero_stage": self.zero_stage,
+            "config_digest": self.config_digest,
+            "params_initialized": self._state is not None,
+        }
+
+    def record_memory_profile(self, batch=None) -> Optional[dict]:
+        """AOT-compile the train step against ABSTRACT args and publish
+        its per-device HBM breakdown as ``hbm_exec_*_bytes{site=
+        "engine.train_step"}`` gauges (telemetry/memory.py).
+
+        Uses the autotuner's abstract-lowering path, so no state is
+        materialized or donated; costs one compile — call it once after
+        init (or from the flops profiler), not per step.  Returns the
+        breakdown dict (None when the backend exposes no analysis)."""
+        from ..telemetry import memory as telemetry_memory
+
+        if batch is None:
+            if not hasattr(self.model, "dummy_inputs"):
+                raise ValueError(
+                    "record_memory_profile needs an example batch: the "
+                    "model exposes no dummy_inputs(batch_size=...)")
+            batch = self.model.dummy_inputs(batch_size=self.train_batch_size)
+        abstract = self.abstract_state(batch)
+        a_batch = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), batch)
+        extra = ()
+        if self.progressive_layer_drop is not None:
+            # the step body takes theta positionally (same scalar kind
+            # train_batch passes); lowering without it would IndexError
+            extra = (jnp.float32(self.progressive_layer_drop.get_theta()),)
+        compiled = self._compiled_train_step.lower(
+            abstract, a_batch, *extra).compile()
+        return telemetry_memory.record_compiled(compiled,
+                                                site="engine.train_step")
 
     # ------------------------------------------------------------------
     # compiled pieces

@@ -1,7 +1,7 @@
 """Unified telemetry layer: metrics registry + step tracer + recompile
-watchdog.
+watchdog + live observability plane.
 
-Three coordinated surfaces replacing the reference's scattered
+Seven coordinated surfaces replacing the reference's scattered
 ``monitor/`` / ``utils/timer.py`` / profiler observability:
 
 - :mod:`.registry` — process-wide counters/gauges/histograms with JSON
@@ -13,17 +13,43 @@ Three coordinated surfaces replacing the reference's scattered
   loop, and (via ``device_span``/HLO metadata) pipeline stage bodies.
 - :mod:`.recompile` — watchdog over jitted hot loops that counts
   distinct compile signatures and warns when a warm loop recompiles.
+- :mod:`.exporter` — per-rank HTTP server (``/metrics`` Prometheus
+  text, ``/healthz`` liveness JSON, ``/statusz`` operational JSON);
+  opt-in via ``dstpu --telemetry_port`` / ``DSTPU_TELEMETRY_PORT``.
+- :mod:`.goodput` — step-phase wall-time attribution (compute /
+  data-wait / checkpoint / recompile / idle) + ``goodput_ratio``.
+- :mod:`.memory` — per-executable HBM accounting
+  (``compiled.memory_analysis()`` normalized behind ONE helper) and
+  live-array memory gauges sampled at scrape time.
+- :mod:`.flightrec` — always-on crash flight recorder (last spans /
+  logs / metric deltas) dumped on atexit, SIGTERM/SIGABRT, and
+  unhandled exceptions; the launcher pretty-prints it on restart.
 
 Launcher integration: ``dstpu --metrics_dir DIR`` injects
 ``DSTPU_METRICS_DIR`` so every rank dumps ``metrics_rank<k>.json`` on
-exit; ``DSTPU_TRACE=/path.json`` auto-enables tracing and writes the
-trace on exit (use ``{rank}`` in the path for multi-rank runs).
+exit (and, with the flight recorder, on SIGTERM) plus
+``flight_<k>.json`` forensics; ``dstpu --telemetry_port P`` serves the
+live endpoints on ``P + rank``; ``DSTPU_TRACE=/path.json`` auto-enables
+tracing and writes the trace on exit (use ``{rank}`` in the path for
+multi-rank runs).
 """
 from . import recompile, trace  # noqa: F401
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, Registry, counter, gauge, get_registry,
     histogram, maybe_install_exit_dump,
 )
+from . import goodput, memory  # noqa: F401  (need registry+trace above)
+from . import exporter, flightrec  # noqa: F401
 
 # arm the per-rank exit dump when the launcher asked for one
 maybe_install_exit_dump()
+# goodput attribution rides span boundaries; always on (near-free)
+goodput.install()
+# live-HBM gauges refresh on every scrape/dump
+from .registry import register_collector as _register_collector  # noqa: E402
+
+_register_collector(memory.sample_live_hbm)
+# crash forensics when a dump dir is configured; live endpoints when a
+# port is configured
+flightrec.maybe_install()
+exporter.maybe_start()

@@ -1,0 +1,369 @@
+"""Crash flight recorder: a per-rank ring buffer dumped on death.
+
+A crashed worker today leaves nothing: the metrics exit dump needs a
+clean ``atexit``, the trace file needs tracing enabled, and the launcher
+only sees an exit code or a stale heartbeat.  The flight recorder keeps
+a small always-on in-memory ring of the last N completed spans (via the
+tracer's span-observer hook — recording works with Chrome tracing OFF),
+the last N ``deepspeed_tpu`` log records (a ``logging.Handler``), and
+recent metric deltas (counter movement between throttled ``mark()``
+calls — wired off ``goodput.note_step`` and the heartbeat), and writes
+``<metrics_dir>/flight_<rank>.json`` from:
+
+- ``atexit`` (clean exits — the dump doubles as a "last run" record),
+- SIGTERM / SIGABRT handlers (the launcher killing a stale worker, a
+  preemption, an XLA abort) — which ALSO flush the per-rank metrics
+  snapshot (``registry.flush_exit_dump``) that a signal death would
+  otherwise lose, then re-deliver the signal so exit semantics hold,
+- an unhandled-exception hook (``sys.excepthook`` chain) that captures
+  the traceback into the dump.
+
+Armed automatically when ``DSTPU_METRICS_DIR`` is set (the launcher's
+``--metrics_dir``); ``launcher/runner.py`` pretty-prints the newest dump
+when it restarts a dead worker.  Everything here is best-effort: a
+failing dump must never mask the original death.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import logging as _logging
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Optional
+
+from ..utils.logging import logger
+from . import registry as _registry
+
+__all__ = ["FlightRecorder", "get_recorder", "maybe_install", "mark",
+           "dump", "pretty", "FLIGHT_DIR_ENV"]
+
+# separate override for the rare case flight dumps should land away from
+# the metrics dir; defaults to DSTPU_METRICS_DIR
+FLIGHT_DIR_ENV = "DSTPU_FLIGHT_DIR"
+
+_SPAN_RING = 256
+_LOG_RING = 200
+_DELTA_RING = 120
+_MARK_MIN_INTERVAL_S = 1.0
+
+
+class _RingLogHandler(_logging.Handler):
+    def __init__(self, ring: deque):
+        super().__init__()
+        self._ring = ring
+
+    def emit(self, record) -> None:
+        try:
+            self._ring.append({
+                "t": record.created,
+                "level": record.levelname,
+                "msg": record.getMessage()[:2000],
+            })
+        except Exception:
+            pass
+
+
+class FlightRecorder:
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._t0_mono = time.monotonic()
+        self._t0_unix = time.time()
+        self.spans: deque = deque(maxlen=_SPAN_RING)
+        self.logs: deque = deque(maxlen=_LOG_RING)
+        self.deltas: deque = deque(maxlen=_DELTA_RING)
+        # RLock: a SIGTERM landing inside mark() must not deadlock the
+        # handler's own registry walk
+        self._mark_lock = threading.RLock()
+        self._last_mark = 0.0
+        self._last_counters: dict = {}
+        self._dumped_reasons: set = set()
+        self._log_handler = _RingLogHandler(self.logs)
+
+    # -- span observer protocol (trace.add_span_observer) --------------
+    def span_enter(self, name: str) -> None:
+        pass
+
+    def span_exit(self, name: str, dur_s: float, args) -> None:
+        self.spans.append({"t": time.time(), "name": name,
+                           "dur_ms": round(dur_s * 1e3, 3),
+                           **({"args": args} if args else {})})
+
+    # -- metric deltas ---------------------------------------------------
+    def _counter_totals(self) -> dict:
+        reg = _registry.get_registry()
+        out = {}
+        with reg._lock:
+            metrics = list(reg._metrics.values())
+        for m in metrics:
+            if m.kind == "counter":
+                out[m.name] = sum(c.value for _, c in m.samples())
+        return out
+
+    def mark(self, label: str = "") -> None:
+        """Record counter movement since the previous mark (throttled to
+        one per second — wired off ``goodput.note_step`` and the
+        heartbeat, so a busy loop costs a dict diff per second)."""
+        now = time.monotonic()
+        with self._mark_lock:
+            if now - self._last_mark < _MARK_MIN_INTERVAL_S:
+                return
+            self._last_mark = now
+            cur = self._counter_totals()
+            prev, self._last_counters = self._last_counters, cur
+        delta = {k: round(v - prev.get(k, 0.0), 6)
+                 for k, v in cur.items() if v != prev.get(k, 0.0)}
+        if delta:
+            self.deltas.append({"t": time.time(), "label": label,
+                                "deltas": delta})
+
+    # -- dumping ---------------------------------------------------------
+    def dump(self, reason: str, exc: Optional[BaseException] = None
+             ) -> Optional[str]:
+        """Write the flight dump; returns the path (None on failure).
+
+        A clean-exit (``atexit``) dump never overwrites a crash dump
+        already written this process: the excepthook fires before
+        interpreter shutdown, and the forensics of the crash are the
+        valuable copy."""
+        if reason == "atexit" and self._dumped_reasons:
+            return None
+        try:
+            from . import goodput
+            from ..utils import heartbeat
+
+            _registry.run_collectors()
+            hb_age = heartbeat.last_beat_age()
+            payload = {
+                "reason": reason,
+                "time_unix": time.time(),
+                "rank": _registry._rank(),
+                "pid": os.getpid(),
+                "argv": list(sys.argv),
+                "uptime_s": round(time.monotonic() - self._t0_mono, 3),
+                "heartbeat_age_s":
+                    None if hb_age is None else round(hb_age, 3),
+                "goodput": goodput.summary(),
+                "spans": list(self.spans),
+                "logs": list(self.logs),
+                "metric_deltas": list(self.deltas),
+                "metrics": _registry.get_registry().snapshot(),
+            }
+            if exc is not None:
+                payload["exception"] = {
+                    "type": type(exc).__name__,
+                    "value": str(exc)[:4000],
+                    "traceback": traceback.format_exception(
+                        type(exc), exc, exc.__traceback__)[-50:],
+                }
+            path = os.path.join(
+                self.directory, f"flight_{_registry._rank()}.json")
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, indent=1, default=str)
+            os.replace(tmp, path)
+            self._dumped_reasons.add(reason.split(":")[0])
+            return path
+        except Exception:
+            return None   # forensics must never mask the original death
+
+
+_recorder: Optional[FlightRecorder] = None
+_prev_handlers: dict = {}
+_prev_excepthook = None
+_atexit_done = False
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def disarm() -> None:
+    """Drop the recorder so dumps become no-ops (installed signal/atexit
+    hooks stay but fall through).  The LAUNCHER calls this: with
+    ``DSTPU_METRICS_DIR`` exported operator-side, the import-armed
+    recorder in the launcher process would otherwise overwrite worker
+    rank 0's forensics at launcher exit."""
+    global _recorder
+    if _recorder is not None:
+        try:
+            from . import trace as _trace
+
+            _trace.remove_span_observer(_recorder)
+            logger.removeHandler(_recorder._log_handler)
+        except Exception:
+            pass
+    _recorder = None
+
+
+def mark(label: str = "") -> None:
+    if _recorder is not None:
+        _recorder.mark(label)
+
+
+def dump(reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
+    return _recorder.dump(reason, exc) if _recorder is not None else None
+
+
+def _on_signal(signum, frame):
+    name = signal.Signals(signum).name if signum in list(signal.Signals) \
+        else str(signum)
+    dump(reason=f"signal:{name}")
+    # the satellite fix: metrics must survive the launcher's SIGTERM
+    # (atexit never runs under default signal death)
+    _registry.flush_exit_dump()
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_IGN:
+        return
+    else:
+        # restore default disposition and re-deliver so the exit status
+        # still says "killed by signal" (the launcher keys off it)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def _on_exception(exc_type, exc, tb):
+    try:
+        dump(reason="exception", exc=exc)
+        _registry.flush_exit_dump()
+    finally:
+        (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def _on_atexit():
+    dump(reason="atexit")
+
+
+def maybe_install(directory: Optional[str] = None) -> Optional[FlightRecorder]:
+    """Arm the flight recorder when a dump directory is configured
+    (``DSTPU_FLIGHT_DIR``, falling back to ``DSTPU_METRICS_DIR``).
+    Idempotent; called on telemetry import.  Returns the recorder."""
+    global _recorder, _prev_excepthook, _atexit_done
+    directory = directory or os.environ.get(FLIGHT_DIR_ENV) \
+        or os.environ.get(_registry.METRICS_DIR_ENV)
+    if not directory:
+        return None
+    if _recorder is not None:
+        _recorder.directory = directory
+        return _recorder
+    _recorder = FlightRecorder(directory)
+
+    from . import trace as _trace
+
+    _trace.add_span_observer(_recorder)
+    logger.addHandler(_recorder._log_handler)
+    if not _atexit_done:
+        atexit.register(_on_atexit)
+        _atexit_done = True
+    if _prev_excepthook is None:
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _on_exception
+    for signum in (signal.SIGTERM, signal.SIGABRT):
+        try:
+            # only from the main thread; a custom handler someone already
+            # installed is chained, not replaced
+            _prev_handlers[signum] = signal.getsignal(signum)
+            signal.signal(signum, _on_signal)
+        except (ValueError, OSError):     # non-main thread / exotic env
+            _prev_handlers.pop(signum, None)
+    return _recorder
+
+
+# ----------------------------------------------------------------------
+# pretty-printing (the launcher's postmortem view)
+# ----------------------------------------------------------------------
+def pretty(path_or_payload, max_spans: int = 8, max_logs: int = 8) -> str:
+    """Human-readable postmortem of a flight dump — what the launcher
+    prints when it restarts a dead worker."""
+    if isinstance(path_or_payload, str):
+        with open(path_or_payload) as fh:
+            p = json.load(fh)
+    else:
+        p = path_or_payload
+    t_dump = p.get("time_unix", 0.0)
+    when = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(t_dump))
+    lines = [f"flight dump: rank {p.get('rank')} pid {p.get('pid')} "
+             f"reason={p.get('reason')} at {when} "
+             f"(uptime {p.get('uptime_s')}s)"]
+    gp = p.get("goodput") or {}
+    if gp.get("last_step_age_s") is not None:
+        lines.append(f"  last step {gp['last_step_age_s']}s before dump; "
+                     f"goodput_ratio={gp.get('goodput_ratio')}")
+    if p.get("heartbeat_age_s") is not None:
+        lines.append(f"  last heartbeat {p['heartbeat_age_s']}s before dump")
+    exc = p.get("exception")
+    if exc:
+        lines.append(f"  died on {exc['type']}: {exc['value']}")
+        for tb_line in exc.get("traceback", [])[-3:]:
+            lines.append("    " + tb_line.rstrip().replace("\n", "\n    "))
+    spans = p.get("spans", [])[-max_spans:]
+    if spans:
+        lines.append(f"  last {len(spans)} spans:")
+        for s in spans:
+            ago = round(t_dump - s["t"], 3)
+            args = f" {s['args']}" if s.get("args") else ""
+            lines.append(f"    -{ago}s {s['name']} "
+                         f"{s['dur_ms']}ms{args}")
+    logs = p.get("logs", [])[-max_logs:]
+    if logs:
+        lines.append(f"  last {len(logs)} log records:")
+        for r in logs:
+            ago = round(t_dump - r["t"], 3)
+            lines.append(f"    -{ago}s [{r['level']}] {r['msg']}")
+    deltas = p.get("metric_deltas", [])[-3:]
+    if deltas:
+        lines.append("  recent metric deltas:")
+        for d in deltas:
+            ago = round(t_dump - d["t"], 3)
+            lines.append(f"    -{ago}s {d.get('label', '')} {d['deltas']}")
+    key = {}
+    for name in ("train_steps_total", "serving_decode_ticks_total",
+                 "serving_requests_completed_total", "xla_recompiles_total",
+                 "heartbeat_beats_total"):
+        entry = (p.get("metrics") or {}).get(name)
+        if entry:
+            key[name] = sum(s.get("value", 0) for s in entry["samples"])
+    if key:
+        lines.append("  key counters: " + " ".join(
+            f"{k}={v:g}" for k, v in key.items()))
+    return "\n".join(lines)
+
+
+def newest_dump(directory: str,
+                since: Optional[float] = None) -> Optional[str]:
+    """Flight dump to show for a failed run (None when there is none) —
+    the launcher's collection hook.
+
+    ``since`` (a unix mtime) STRICTLY drops dumps from a previous
+    restart attempt — a stale dump presented as this failure's
+    postmortem would send the operator debugging the wrong death.
+    Among current dumps, a CRASH dump (exception / SIGABRT) wins over
+    ``signal:SIGTERM`` ones even when older: when one rank dies, the
+    launcher SIGTERMs the healthy rest, whose dumps land LATER —
+    newest-by-mtime alone would show a victim, not the cause."""
+    try:
+        cands = [os.path.join(directory, f) for f in os.listdir(directory)
+                 if f.startswith("flight_") and f.endswith(".json")]
+        if since is not None:
+            cands = [p for p in cands if os.path.getmtime(p) >= since]
+        if not cands:
+            return None
+        cands.sort(key=os.path.getmtime, reverse=True)
+        for path in cands:
+            try:
+                with open(path) as fh:
+                    if json.load(fh).get("reason") != "signal:SIGTERM":
+                        return path
+            except Exception:
+                continue
+        return cands[0]
+    except OSError:
+        return None

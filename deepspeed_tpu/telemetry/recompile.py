@@ -143,7 +143,20 @@ class _Watched:
             self._sigs.add(sig)
             self._dog._on_new_signature(self, sig, self._last_sig, first)
         self._last_sig = sig
-        out = self._fn(*args, **kwargs)
+        if is_new:
+            # a new signature means this call pays trace+compile before
+            # dispatch returns — bill it to the goodput "recompile" phase
+            # (warm-up included: compile time is not goodput either way)
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            try:
+                from . import goodput
+
+                goodput.note_compile(time.perf_counter() - t0)
+            except Exception:
+                pass
+        else:
+            out = self._fn(*args, **kwargs)
         # cross-check: jax.jit's C++ cache also keys on SHARDINGS and
         # layouts, which the host-side signature cannot see — if the
         # executable count grew on an already-known signature, the loop

@@ -35,7 +35,8 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "get_registry", "counter", "gauge", "histogram",
-    "maybe_install_exit_dump", "METRICS_DIR_ENV",
+    "maybe_install_exit_dump", "flush_exit_dump", "register_collector",
+    "run_collectors", "METRICS_DIR_ENV",
 ]
 
 METRICS_DIR_ENV = "DSTPU_METRICS_DIR"
@@ -235,7 +236,11 @@ class Registry:
     """Named metric store with JSON + Prometheus export."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # REENTRANT: the flight recorder's SIGTERM handler runs on the
+        # main thread and reads the registry; a plain Lock held by the
+        # interrupted increment would deadlock shutdown and lose the
+        # forensics the handler exists to save
+        self._lock = threading.RLock()
         self._metrics: Dict[str, _Metric] = {}
 
     # -- get-or-create handles ----------------------------------------
@@ -388,7 +393,60 @@ def _rank() -> int:
     return 0
 
 
+# -- scrape-time collectors --------------------------------------------
+# Gauges that must be SAMPLED rather than pushed (live-array HBM, the
+# goodput ratio) register a collector; every export surface (the HTTP
+# exporter, the exit dump, the flight recorder) refreshes them via
+# run_collectors() immediately before reading the registry, so a scrape
+# never serves a value staler than the previous scrape.
+_collectors: list = []
+
+
+def register_collector(fn) -> None:
+    """Register ``fn()`` to run before every export/scrape (idempotent)."""
+    if fn not in _collectors:
+        _collectors.append(fn)
+
+
+def run_collectors() -> None:
+    """Run every registered collector; one failing collector never takes
+    down a scrape (or interpreter shutdown)."""
+    for fn in list(_collectors):
+        try:
+            fn()
+        except Exception:
+            pass
+
+
 _exit_dump_installed: Optional[str] = None
+
+
+def disarm_exit_dump() -> None:
+    """Make the (already-registered) exit dump a no-op — the launcher
+    process must not clobber worker rank 0's ``metrics_rank0.json``
+    when the operator exported ``DSTPU_METRICS_DIR`` shell-wide."""
+    global _exit_dump_installed
+    _exit_dump_installed = None
+
+
+def flush_exit_dump() -> Optional[str]:
+    """Write the per-rank exit dump NOW (refreshing collectors first).
+
+    Callable from signal handlers as well as ``atexit`` — SIGTERM (the
+    launcher killing a stale worker, or a preemption) does not run
+    ``atexit`` hooks, so the flight recorder's SIGTERM handler calls this
+    to keep the rank's final snapshot from being lost.  No-op when no
+    dump directory was ever armed; returns the written path."""
+    if not _exit_dump_installed:
+        return None
+    try:
+        run_collectors()
+        path = os.path.join(_exit_dump_installed,
+                            f"metrics_rank{_rank()}.json")
+        _default_registry.dump(path)
+        return path
+    except Exception:
+        return None   # never let a metrics dump break shutdown paths
 
 
 def maybe_install_exit_dump(directory: Optional[str] = None) -> Optional[str]:
@@ -407,14 +465,8 @@ def maybe_install_exit_dump(directory: Optional[str] = None) -> Optional[str]:
         return None
     if _exit_dump_installed == directory:
         return directory
+    already_armed = _exit_dump_installed is not None
     _exit_dump_installed = directory
-
-    def _dump():
-        try:
-            _default_registry.dump(
-                os.path.join(directory, f"metrics_rank{_rank()}.json"))
-        except Exception:
-            pass   # never let a metrics dump break interpreter shutdown
-
-    atexit.register(_dump)
+    if not already_armed:
+        atexit.register(flush_exit_dump)
     return directory

@@ -30,7 +30,8 @@ import time
 from typing import Optional
 
 __all__ = ["span", "device_span", "enable", "disable", "enabled", "clear",
-           "save", "to_json", "TRACE_ENV", "TRACE_JAX_ENV"]
+           "save", "to_json", "add_span_observer", "remove_span_observer",
+           "TRACE_ENV", "TRACE_JAX_ENV"]
 
 TRACE_ENV = "DSTPU_TRACE"
 TRACE_JAX_ENV = "DSTPU_TRACE_JAX"
@@ -56,6 +57,24 @@ class _Tracer:
 
 _tracer = _Tracer()
 
+# Span observers: objects with ``span_enter(name)`` / ``span_exit(name,
+# dur_s, args)`` notified on every span REGARDLESS of whether the Chrome-
+# trace recorder is enabled — the goodput phase tracker and the crash
+# flight recorder ride the same span boundaries the trace file does, but
+# must work in production where tracing stays off.  An observer raising
+# never breaks the instrumented code path.
+_observers: list = []
+
+
+def add_span_observer(obs) -> None:
+    if obs not in _observers:
+        _observers.append(obs)
+
+
+def remove_span_observer(obs) -> None:
+    if obs in _observers:
+        _observers.remove(obs)
+
 
 class span:
     """Context manager / decorator recording one complete trace event.
@@ -63,18 +82,20 @@ class span:
     ``args`` (small JSON-ables only) land in the event's ``args`` dict —
     visible in the Perfetto detail pane."""
 
-    __slots__ = ("name", "args", "_t0", "_jax_ctx")
+    __slots__ = ("name", "args", "_t0", "_jax_ctx", "_rec")
 
     def __init__(self, name: str, **args):
         self.name = name
         self.args = args or None
         self._t0 = None
         self._jax_ctx = None
+        self._rec = False
 
     def __enter__(self):
-        if not _tracer.enabled:
+        if not _tracer.enabled and not _observers:
             return self
-        if _tracer.jax_bridge:
+        self._rec = _tracer.enabled
+        if self._rec and _tracer.jax_bridge:
             try:
                 import jax.profiler
 
@@ -82,6 +103,11 @@ class span:
                 self._jax_ctx.__enter__()
             except Exception:
                 self._jax_ctx = None
+        for obs in _observers:
+            try:
+                obs.span_enter(self.name)
+            except Exception:
+                pass
         self._t0 = _tracer.now_us()
         return self
 
@@ -95,17 +121,24 @@ class span:
             except Exception:
                 pass
             self._jax_ctx = None
-        ev = {"name": self.name, "ph": "X", "ts": self._t0,
-              "dur": t1 - self._t0, "pid": _tracer.pid,
-              "tid": threading.get_ident()}
-        if self.args:
-            ev["args"] = self.args
-        with _tracer.lock:
-            if len(_tracer.events) < _MAX_EVENTS:
-                _tracer.events.append(ev)
-            else:
-                _tracer.dropped += 1
+        if self._rec:
+            ev = {"name": self.name, "ph": "X", "ts": self._t0,
+                  "dur": t1 - self._t0, "pid": _tracer.pid,
+                  "tid": threading.get_ident()}
+            if self.args:
+                ev["args"] = self.args
+            with _tracer.lock:
+                if len(_tracer.events) < _MAX_EVENTS:
+                    _tracer.events.append(ev)
+                else:
+                    _tracer.dropped += 1
+        for obs in _observers:
+            try:
+                obs.span_exit(self.name, (t1 - self._t0) / 1e6, self.args)
+            except Exception:
+                pass
         self._t0 = None
+        self._rec = False
         return False
 
     def __call__(self, fn):

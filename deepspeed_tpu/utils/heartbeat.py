@@ -18,11 +18,12 @@ import time
 
 ENV_VAR = "DSTPU_HEARTBEAT_FILE"
 _last_beat = 0.0
+_ever_beat = False
 
 
 def beat(min_interval_s: float = 1.0) -> bool:
     """Touch the heartbeat file if configured; returns True if touched."""
-    global _last_beat
+    global _last_beat, _ever_beat
     path = os.environ.get(ENV_VAR)
     if not path:
         return False
@@ -30,6 +31,7 @@ def beat(min_interval_s: float = 1.0) -> bool:
     if now - _last_beat < min_interval_s:
         return False
     _last_beat = now
+    _ever_beat = True
     with open(path, "w") as fh:
         fh.write(str(time.time()))
     try:
@@ -39,4 +41,19 @@ def beat(min_interval_s: float = 1.0) -> bool:
                      "heartbeat file touches (launcher liveness)").inc()
     except Exception:
         pass   # the failure detector must never depend on telemetry
+    try:
+        from ..telemetry import flightrec
+
+        flightrec.mark("heartbeat")   # ≤1/s metric-delta ring entry
+    except Exception:
+        pass
     return True
+
+
+def last_beat_age() -> float | None:
+    """Seconds since this process last touched its heartbeat file (the
+    ``/healthz`` freshness number); None before the first beat or when
+    no heartbeat file is configured."""
+    if not _ever_beat:
+        return None
+    return time.monotonic() - _last_beat

@@ -132,6 +132,7 @@ class ThroughputTimer:
         self.step_elapsed_time = 0.0
         self.started = False
         self.start_time = 0.0
+        self._metric_prefix = metric_prefix
         # telemetry-registry surface (telemetry/registry.py): a steps
         # counter per stop (dict lookup + add), throughput gauges at
         # report boundaries only (same cadence as the log line)
@@ -165,6 +166,13 @@ class ThroughputTimer:
             self.global_step_count += 1
             self._m_steps.inc()
             self._m_samples.inc(self.batch_size)
+            # /healthz last-step age + flight-recorder metric-delta mark
+            try:
+                from ..telemetry import goodput
+
+                goodput.note_step(self._metric_prefix)
+            except Exception:
+                pass
         if self.global_step_count > self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
