@@ -1,2 +1,3 @@
 from .engine import InferenceEngine  # noqa: F401
+from .kvreuse import PagedKVPool, RadixPrefixCache  # noqa: F401
 from .serving import ContinuousBatcher  # noqa: F401

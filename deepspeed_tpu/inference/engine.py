@@ -58,6 +58,12 @@ class InferenceConfig:
     # families with a decode_fused config field; None keeps the model's
     # own flag.  DS_TPU_DECODE_FUSED env-overrides either way.
     decode_fused: Optional[bool] = None
+    # shared-prefix KV reuse for the serving plane (inference/kvreuse.py):
+    # True enables with default sizing, a dict may set page_tokens /
+    # n_pages / budget_bytes; DSTPU_PREFIX_CACHE env-overrides either
+    # way.  Consumed by ContinuousBatcher at construction — plain
+    # generate() calls are unaffected.
+    prefix_cache: Any = None
 
     @staticmethod
     def load(d) -> "InferenceConfig":

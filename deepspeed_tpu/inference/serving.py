@@ -29,8 +29,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models import common as model_common
 from ..telemetry import (goodput, memory as telemetry_memory, recompile,
                          registry as telemetry_registry, trace)
+from . import kvreuse
 from .engine import InferenceEngine, _sample
 
 
@@ -62,11 +64,18 @@ class ContinuousBatcher:
                  top_k: int = 0, eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None, seed: int = 0,
                  chunked_prefill: bool = True,
-                 prefill_ahead: Optional[int] = None):
+                 prefill_ahead: Optional[int] = None,
+                 prefix_cache=None):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
         self.n_slots = n_slots
+        # shared-prefix KV reuse (inference/kvreuse.py): None defers to
+        # the engine config / DSTPU_PREFIX_CACHE env; the resolved cache
+        # is None when disabled — and then every path below is
+        # byte-for-byte the cache-less admission
+        self.prefix_cache = kvreuse.resolve_prefix_cache(engine,
+                                                         prefix_cache)
         self.top_k = int(top_k)
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.pad = int(pad_token_id if pad_token_id is not None
@@ -144,6 +153,16 @@ class ContinuousBatcher:
             "serving_active_slots", "occupied decode slots")
         self._m_queue = telemetry_registry.gauge(
             "serving_queue_depth", "queued + parked requests")
+        # the _shrink_parked hazard, metered: parked rows pin their whole
+        # B-row prefill cache BY REFERENCE, so the bytes held alive can be
+        # B× what the parked-row count suggests
+        self._m_parked_bytes = telemetry_registry.gauge(
+            "serving_parked_bytes",
+            "bytes pinned by parked prefill caches (deduped by buffer)")
+        self._m_prefill_tokens = telemetry_registry.counter(
+            "serving_prefill_tokens_total",
+            "tokens run through prefill (padding included — compute, "
+            "not admission, tokens)")
         # /statusz section (weakly held: a dropped batcher must not be
         # pinned — it holds the engine and therefore the params in HBM)
         from ..telemetry import exporter as telemetry_exporter
@@ -272,7 +291,7 @@ class ContinuousBatcher:
                     (i,) + (0,) * small.ndim)
 
             def put_cache(path, big, small):
-                if getattr(path[-1], "key", None) == "cache_index":
+                if model_common.cache_leaf_kind(path) == "index":
                     # bucket-padded prefill leaves the write head at the
                     # PADDED width with K/V garbage at [prompt_len,
                     # bucket): rewind to the real length so decode ticks
@@ -317,7 +336,7 @@ class ContinuousBatcher:
             pos = pos.at[i].set(0)
 
             def reset(path, leaf):
-                if getattr(path[-1], "key", None) == "cache_index":
+                if model_common.cache_leaf_kind(path) == "index":
                     return leaf.at[i].set(0)
                 return leaf
 
@@ -363,6 +382,12 @@ class ContinuousBatcher:
         submits never reads a stale depth."""
         self._m_queue.set(len(self._queue) + len(self._parked))
         self._m_active.set(sum(s is not None for s in self._slots))
+        seen_bufs, parked_bytes = set(), 0
+        for entry in self._parked:
+            if id(entry[1]) not in seen_bufs:     # rows share cacheB
+                seen_bufs.add(id(entry[1]))
+                parked_bytes += telemetry_memory.tree_bytes(entry[1])
+        self._m_parked_bytes.set(float(parked_bytes))
 
     def _telemetry_status(self) -> dict:
         """The ``/statusz`` ``serving`` section (telemetry/exporter.py)."""
@@ -377,12 +402,21 @@ class ContinuousBatcher:
             "finished_buffered": len(self._finished),
             "prefill_ahead": self.prefill_ahead,
             "gen_limit": int(self.engine._gen_limit),
+            "parked_bytes": int(self._m_parked_bytes.value),
+            "prefix_cache": self.prefix_cache is not None,
         }
 
     # ------------------------------------------------------------------
-    def _prefill(self, ids):
-        """Prefill of ``ids`` (B, S) — B prompts of equal length — into a
-        fresh B-row cache.
+    def _prefill(self, ids, cache=None, start: int = 0):
+        """Prefill of ``ids`` (B, S) — B prompts of equal length — into
+        ``cache`` (a fresh B-row cache when None) at positions
+        ``[start, start + S)``.
+
+        ``start > 0`` is the prefix-cache path: the cache arrives with
+        its first ``start`` positions gathered from pooled pages and its
+        write head already at ``start``, so only the suffix is computed.
+        Positions are an ARGUMENT of the compiled prefill, so offset
+        prefills reuse the same executables as the from-zero path.
 
         ``chunked_prefill`` feeds the prompt as DESCENDING power-of-two
         chunks (the binary decomposition of its length), so across every
@@ -393,18 +427,25 @@ class ContinuousBatcher:
         cache)."""
         eng = self.engine
         S = ids.shape[1]
-        with trace.span("serve/prefill", rows=int(ids.shape[0]), len=int(S)):
-            cache = eng.init_cache(ids.shape[0])
+        with trace.span("serve/prefill", rows=int(ids.shape[0]), len=int(S),
+                        start=int(start)):
+            if cache is None:
+                cache = eng.init_cache(ids.shape[0])
+            self._m_prefill_tokens.inc(int(ids.shape[0]) * int(S))
             if not self.chunked_prefill:
+                positions = jnp.asarray(
+                    np.arange(start, start + S, dtype=np.int32))[None, :]
                 return eng._compiled_prefill(eng.params, cache, ids,
-                                             jnp.arange(S)[None, :])
+                                             positions)
             pos = 0
             logits = None
             chunk = 1 << (S.bit_length() - 1)
             while chunk:
                 if S & chunk:
                     seg = ids[:, pos:pos + chunk]
-                    positions = (pos + jnp.arange(chunk))[None, :]
+                    positions = jnp.asarray(np.arange(
+                        start + pos, start + pos + chunk,
+                        dtype=np.int32))[None, :]
                     logits, cache = eng._compiled_prefill(eng.params, cache,
                                                           seg, positions)
                     pos += chunk
@@ -427,17 +468,38 @@ class ContinuousBatcher:
         serial prefills.  Without ``chunked_prefill`` only exactly-equal
         lengths group (the pre-bucketing behavior).  A request finished by
         its first token (eos or max_new_tokens<=1) completes without ever
-        occupying a slot."""
+        occupying a slot.
+
+        With a prefix cache, the longest cached prefix is looked up per
+        request and only the unmatched SUFFIX is prefilled (the matched
+        pages are gathered into the cache first, write head at the match
+        length).  Grouping then keys on (matched pages, suffix bucket):
+        a burst sharing a system prompt matches the same pages and still
+        batches into one prefill.  Reuse is exact-match only, and the
+        match is capped one token short of the prompt — the real last
+        token always runs through prefill to produce sampling logits."""
+        pc = self.prefix_cache
         while self._queue and max_new > 0:
-            plen = len(self._queue[0].prompt)
-            bucket = 1 << (plen - 1).bit_length()
+            if pc is not None:
+                m0, pids0, nodes0 = pc.match(self._queue[0].prompt)
+            else:
+                m0, pids0, nodes0 = 0, (), ()
+            sfx0 = len(self._queue[0].prompt) - m0
+            bucket = 1 << (sfx0 - 1).bit_length()
             bucketed = self.chunked_prefill and \
-                bucket <= self.engine._gen_limit
+                m0 + bucket <= self.engine._gen_limit
 
             def same_group(r):
+                if pc is not None:
+                    m, pids, _ = pc.match(r.prompt)
+                    if pids != pids0:
+                        return False
+                else:
+                    m = 0
+                s = len(r.prompt) - m
                 if bucketed:
-                    return 1 << (len(r.prompt) - 1).bit_length() == bucket
-                return len(r.prompt) == plen
+                    return 1 << (s - 1).bit_length() == bucket
+                return s == sfx0
 
             reqs = [self._queue.popleft()]
             while (self._queue and len(reqs) < max_new
@@ -445,19 +507,42 @@ class ContinuousBatcher:
                 reqs.append(self._queue.popleft())
             max_new -= len(reqs)
             B = len(reqs)
-            lens = np.asarray([len(r.prompt) for r in reqs], np.int32)
-            if bucketed and (lens != lens[0]).any():
-                ids_np = np.full((B, bucket), self.pad, np.int32)
-                for row, r in enumerate(reqs):
-                    ids_np[row, :lens[row]] = r.prompt
-                logits, cacheB = self._prefill(jnp.asarray(ids_np))
-                # per-row REAL last-token logits (the pad positions'
-                # logits are sampling garbage)
-                last = logits[jnp.arange(B), jnp.asarray(lens) - 1][:, None]
-            else:   # uniform length: exact prefill, no pad compute
-                ids = jnp.asarray(np.stack([r.prompt for r in reqs]))
-                logits, cacheB = self._prefill(ids)
-                last = logits[:, -1:, :]
+            # suffix lengths: with no prefix cache (or no match) the
+            # suffix IS the whole prompt and everything below reduces to
+            # the pre-existing path
+            lens = np.asarray([len(r.prompt) - m0 for r in reqs], np.int32)
+            cacheB = None
+            try:
+                if m0:
+                    # matched pages → rows [0, B) of a fresh cache; pin
+                    # the nodes until the copy is dispatched so eviction
+                    # (driven by a donation on this thread) cannot
+                    # recycle them first — unpinned in the finally so a
+                    # failing prefill can't leak the pins and strand the
+                    # pages unevictable
+                    pc.pin(nodes0)
+                    cacheB = pc.gather(self.engine.init_cache(B), pids0)
+                if bucketed and (lens != lens[0]).any():
+                    ids_np = np.full((B, bucket), self.pad, np.int32)
+                    for row, r in enumerate(reqs):
+                        ids_np[row, :lens[row]] = r.prompt[m0:]
+                    logits, cacheB = self._prefill(jnp.asarray(ids_np),
+                                                   cache=cacheB, start=m0)
+                    # per-row REAL last-token logits (the pad positions'
+                    # logits are sampling garbage)
+                    last = logits[jnp.arange(B),
+                                  jnp.asarray(lens) - 1][:, None]
+                else:   # uniform length: exact prefill, no pad compute
+                    ids = jnp.asarray(np.stack([r.prompt[m0:]
+                                                for r in reqs]))
+                    logits, cacheB = self._prefill(ids, cache=cacheB,
+                                                   start=m0)
+                    last = logits[:, -1:, :]
+            finally:
+                if m0:
+                    pc.unpin(nodes0)
+            if pc is not None:
+                pc.note_tokens(hit=m0 * B, miss=int(lens.sum()))
             # fixed shapes only reach the jitted sampler: the last-token
             # logits rows and a HOST-built (B, 1, V) prompt mask — so it
             # compiles once per batch width across all prompt lengths
@@ -553,6 +638,14 @@ class ContinuousBatcher:
             [act.req.prompt, np.asarray(act.emitted, np.int32)])
         self._record_latency(act.req.uid)
         self._slots[i] = None
+        if self.prefix_cache is not None:
+            # donate the prompt-prefix pages BEFORE retire_fn: retire
+            # donates the cache buffer to XLA, and the copy must read
+            # slot i's prompt region first (dispatch order guarantees
+            # it).  The region is intact — decode only ever writes at
+            # positions >= prompt_len, overshoot writes clamp at the
+            # cache edge, and both stay past the prefix.
+            self.prefix_cache.donate(self._cache, i, act.req.prompt)
         self._done, self._pos, self._cache = self._retire_fn(
             self._done, self._pos, self._cache, i)
         self._update_occupancy_gauges()

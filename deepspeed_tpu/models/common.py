@@ -203,6 +203,27 @@ def declare_fused_proj(module: nn.Module, cfg, name: str, names: tuple,
     return w, b.astype(cfg.dtype)
 
 
+# Cache-collection leaf names — THE layout contract ``append_kv_cache``
+# establishes.  Everything that walks a cache tree structurally (serving
+# placement/retire, the paged KV pool in ``inference/kvreuse.py``)
+# classifies leaves through :func:`cache_leaf_kind` instead of repeating
+# the string match, so a renamed leaf breaks loudly in one place.
+KV_CACHE_LEAVES = ("cached_key", "cached_value")
+CACHE_INDEX_LEAF = "cache_index"
+
+
+def cache_leaf_kind(path) -> Optional[str]:
+    """``"kv"`` (a paged K/V buffer), ``"index"`` (the write head) or
+    ``None`` (unknown — present only in models outside the
+    ``append_kv_cache`` contract) for a cache-collection tree path."""
+    key = getattr(path[-1], "key", None)
+    if key in KV_CACHE_LEAVES:
+        return "kv"
+    if key == CACHE_INDEX_LEAF:
+        return "index"
+    return None
+
+
 def append_kv_cache(module: nn.Module, k: jax.Array, v: jax.Array,
                     cache_len: int, dtype):
     """Append this step's K/V ``(B, S, H, D)`` into the module's mutable

@@ -29,7 +29,7 @@ from typing import Optional
 from . import registry as _registry
 
 __all__ = ["memory_breakdown", "peak_bytes", "record_compiled",
-           "per_device_shard_bytes", "sample_live_hbm"]
+           "per_device_shard_bytes", "sample_live_hbm", "tree_bytes"]
 
 # (gauge suffix, CompiledMemoryStats attribute)
 _FIELDS = (
@@ -85,6 +85,24 @@ def record_compiled(compiled, site: str,
             f"per-device {key} bytes of the compiled executable",
             labelnames=("site",)).labels(site=site).set(value)
     return bd
+
+
+def tree_bytes(tree) -> int:
+    """Total GLOBAL bytes of every leaf in ``tree`` — works on real
+    arrays and on ``ShapeDtypeStruct`` trees alike, so the same
+    arithmetic sizes a page budget from an abstract cache tree
+    (``inference/kvreuse.py``) and meters live parked prefill caches
+    (``serving_parked_bytes``).  Logical bytes, not per-device shards —
+    use :func:`per_device_shard_bytes` for residency."""
+    import math
+
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += math.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+    return int(total)
 
 
 def per_device_shard_bytes(arrays) -> tuple:
