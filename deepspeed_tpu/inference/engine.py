@@ -64,6 +64,13 @@ class InferenceConfig:
     # way.  Consumed by ContinuousBatcher at construction — plain
     # generate() calls are unaffected.
     prefix_cache: Any = None
+    # speculative decoding for the serving plane (inference/specdec.py):
+    # True enables the host-side n-gram drafter with defaults, a dict
+    # may set k / drafter / max_ngram / min_accept / window / cooldown;
+    # DSTPU_SPECDEC env-overrides either way.  Consumed by
+    # ContinuousBatcher at construction — plain generate() calls are
+    # unaffected.
+    specdec: Any = None
 
     @staticmethod
     def load(d) -> "InferenceConfig":
@@ -533,27 +540,25 @@ class InferenceEngine:
         return jnp.concatenate([input_ids] + [t[:, None] for t in tokens], axis=1)
 
 
-def _sample(logits, rng, temperature, top_k: int, top_p=1.0,
-            repetition_penalty=1.0, seen_mask=None):
-    """Greedy / temperature / top-k / top-p sampling with repetition
-    penalty on fp32 logits (B, V).  ``top_k`` is static.  ``top_p`` and
-    ``temperature`` may be python floats (static — dead branches like the
-    O(V log V) nucleus sort are dropped at trace time: a greedy decode
-    step compiles to penalty+argmax only) or traced scalars (the
-    per-request path in ``ContinuousBatcher``).
-
-    ``seen_mask`` (B, V) bool marks tokens already in the sequence; those
+def _penalized_logits(logits, repetition_penalty=1.0, seen_mask=None):
+    """Repetition penalty on fp32 logits (B, V): ``seen_mask`` tokens'
     logits are divided (if positive) or multiplied (if negative) by the
-    penalty — the standard CTRL-style rule HF implements.
-    """
+    penalty — the standard CTRL-style rule HF implements.  Shared by
+    :func:`_sample` and the speculative verify chain
+    (``inference/specdec.py``) so the two cannot drift."""
     if seen_mask is not None:
         pen = jnp.where(logits > 0, logits / repetition_penalty,
                         logits * repetition_penalty)
         logits = jnp.where(seen_mask, pen, logits)
-    greedy = jnp.argmax(logits, axis=-1)
-    static_greedy = isinstance(temperature, (int, float)) and temperature <= 0.0
-    if static_greedy:
-        return greedy
+    return logits
+
+
+def _filtered_logits(logits, temperature, top_k: int, top_p=1.0):
+    """PENALIZED logits → the categorical's input: temperature scaling,
+    static top-k mask, nucleus mask (live when ``top_p`` is traced or a
+    non-trivial static).  ``softmax`` of the result is the target
+    distribution speculative rejection sampling must preserve — one
+    implementation, shared with ``inference/specdec.py``."""
     scaled = logits / jnp.maximum(temperature, 1e-6)
     if top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
@@ -571,5 +576,23 @@ def _sample(logits, rng, temperature, top_k: int, top_p=1.0,
         thr = jnp.min(jnp.where(kept, sorted_desc, jnp.inf), axis=-1,
                       keepdims=True)
         scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
+    return scaled
+
+
+def _sample(logits, rng, temperature, top_k: int, top_p=1.0,
+            repetition_penalty=1.0, seen_mask=None):
+    """Greedy / temperature / top-k / top-p sampling with repetition
+    penalty on fp32 logits (B, V).  ``top_k`` is static.  ``top_p`` and
+    ``temperature`` may be python floats (static — dead branches like the
+    O(V log V) nucleus sort are dropped at trace time: a greedy decode
+    step compiles to penalty+argmax only) or traced scalars (the
+    per-request path in ``ContinuousBatcher``).
+    """
+    logits = _penalized_logits(logits, repetition_penalty, seen_mask)
+    greedy = jnp.argmax(logits, axis=-1)
+    static_greedy = isinstance(temperature, (int, float)) and temperature <= 0.0
+    if static_greedy:
+        return greedy
+    scaled = _filtered_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(jnp.asarray(temperature) <= 0.0, greedy, sampled)

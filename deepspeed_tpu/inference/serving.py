@@ -33,7 +33,13 @@ from ..models import common as model_common
 from ..telemetry import (goodput, memory as telemetry_memory, recompile,
                          registry as telemetry_registry, trace)
 from . import kvreuse
+from . import specdec as specdec_mod
 from .engine import InferenceEngine, _sample
+
+# per-output-token latency lands anywhere from sub-ms (fused TPU ticks)
+# to seconds (CPU-mesh tests); ms-denominated buckets spanning both
+_TPOT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                 250.0, 500.0, 1000.0, 2500.0, 5000.0)
 
 
 @dataclasses.dataclass
@@ -65,7 +71,7 @@ class ContinuousBatcher:
                  pad_token_id: Optional[int] = None, seed: int = 0,
                  chunked_prefill: bool = True,
                  prefill_ahead: Optional[int] = None,
-                 prefix_cache=None):
+                 prefix_cache=None, specdec=None):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
@@ -82,6 +88,13 @@ class ContinuousBatcher:
                        else (eos_token_id if eos_token_id is not None else 0))
         self.seed = seed
         self.chunked_prefill = chunked_prefill
+        # speculative decoding (inference/specdec.py): None defers to
+        # the engine config / DSTPU_SPECDEC env; when the resolved
+        # decoder is None every decode path below is byte-for-byte the
+        # pre-existing plain-tick loop
+        self.specdec = specdec_mod.resolve_specdec(engine, specdec)
+        if self.specdec is not None:
+            self.specdec.attach(self)
         cfg = engine.decode_cfg
         self._vocab = int(getattr(cfg, "padded_vocab_size", None)
                           or cfg.vocab_size)
@@ -149,6 +162,15 @@ class ContinuousBatcher:
             "serving_ttft_seconds", "submit -> first token on host")
         self._m_e2e = telemetry_registry.histogram(
             "serving_e2e_seconds", "submit -> retirement")
+        # TPOT (time per output token): decode-window wall time divided
+        # by tokens actually emitted in that window — the denominator
+        # speculative decoding moves, so its win shows up on /metrics
+        # right next to TTFT
+        self._m_tpot = telemetry_registry.histogram(
+            "serving_tpot_ms",
+            "decode wall ms per emitted token per decode/verify window",
+            buckets=_TPOT_BUCKETS)
+        self._tpot_window: deque = deque(maxlen=512)   # /statusz mean
         self._m_active = telemetry_registry.gauge(
             "serving_active_slots", "occupied decode slots")
         self._m_queue = telemetry_registry.gauge(
@@ -404,7 +426,16 @@ class ContinuousBatcher:
             "gen_limit": int(self.engine._gen_limit),
             "parked_bytes": int(self._m_parked_bytes.value),
             "prefix_cache": self.prefix_cache is not None,
+            "specdec": self.specdec is not None,
+            "tpot_ms": None if not self._tpot_window else round(
+                sum(self._tpot_window) / len(self._tpot_window), 3),
         }
+
+    def _note_tpot(self, wall_s: float, tokens: int) -> None:
+        """One decode/verify window's per-output-token latency."""
+        ms = wall_s * 1000.0 / tokens
+        self._m_tpot.observe(ms)
+        self._tpot_window.append(ms)
 
     # ------------------------------------------------------------------
     def _prefill(self, ids, cache=None, start: int = 0):
@@ -427,6 +458,14 @@ class ContinuousBatcher:
         cache)."""
         eng = self.engine
         S = ids.shape[1]
+        if start and cache is None:
+            # an offset prefill writes at positions [start, start+S) of a
+            # cache whose first ``start`` rows it assumes are already
+            # populated; a fresh cache has none — decode would attend to
+            # zero-filled K/V and silently produce garbage
+            raise ValueError(
+                f"offset prefill (start={start}) requires the cache that "
+                f"already holds positions [0, {start}); pass cache=")
         with trace.span("serve/prefill", rows=int(ids.shape[0]), len=int(S),
                         start=int(start)):
             if cache is None:
@@ -651,6 +690,105 @@ class ContinuousBatcher:
         self._update_occupancy_gauges()
 
     # ------------------------------------------------------------------
+    def _spec_tick(self, greedy: bool) -> bool:
+        """One speculative verify tick: draft on host, verify every slot
+        in ONE batched forward, append/retire the accepted tokens.
+
+        Per-slot proposals are capped at ``min(k, remaining-1,
+        cache headroom)`` and the pool verify width is the pow2 round-up
+        of the longest real proposal, clamped to the TIGHTEST slot's
+        cache headroom — the verify forward writes ``w+1`` K/V rows into
+        EVERY slot's cache (dynamic_update_slice clamps the chunk START,
+        so an oversized chunk near the cache edge would overwrite valid
+        history, unlike the single-token overshoot which only clamps
+        past it).  Returns False when no slot drafted (the caller runs a
+        plain window instead — a silent drafter costs nothing)."""
+        spec = self.specdec
+        k = spec.cfg.k
+        limit = int(self.engine._gen_limit)
+        props: List[np.ndarray] = [np.empty((0,), np.int32)] * self.n_slots
+        pool_cap: Optional[int] = None
+        for i, act in enumerate(self._slots):
+            if act is None:
+                continue
+            # pos_i = the position of the slot's last emitted token (the
+            # next input); the verify chunk occupies [pos_i, pos_i + w]
+            pos_i = len(act.req.prompt) + len(act.emitted) - 1
+            cap_i = limit - pos_i - 1
+            pool_cap = cap_i if pool_cap is None else min(pool_cap, cap_i)
+        if not pool_cap or pool_cap <= 0:
+            return False
+        for i, act in enumerate(self._slots):
+            if act is None:
+                continue
+            r = act.req.max_new_tokens - len(act.emitted)
+            cap = min(k, pool_cap, r - 1)   # drafts past r-1 are wasted:
+            if cap <= 0:                    # the bonus token is the r-th
+                continue
+            ctx = np.concatenate([act.req.prompt,
+                                  np.asarray(act.emitted, np.int32)])
+            p = np.asarray(spec.drafter.propose(ctx, cap),
+                           np.int32).reshape(-1)[:cap]
+            bad = (p < 0) | (p >= self._vocab)
+            if bad.any():   # a buggy drafter must not poison the embed
+                p = p[:int(np.argmax(bad))]
+            props[i] = p
+        if max(len(p) for p in props) == 0:
+            spec.note_empty()
+            return False
+        w = 1 << (max(len(p) for p in props) - 1).bit_length()
+        if w > pool_cap:   # pow2 round-up may not breach the cache bound
+            w = 1 << (pool_cap.bit_length() - 1)
+            props = [p[:w] for p in props]
+        # tally AFTER the clamp: a truncated proposal's dropped tokens
+        # were never verified, so counting them would report phantom
+        # misses and bias the controller's EWMA toward cooldown
+        drafted = sum(len(p) for p in props)
+        # padded draft entries can only ACCEPT when the target's own
+        # token happens to equal the pad — correct by construction, and
+        # excluded from the drafted/accepted accounting below
+        drafts_np = np.full((self.n_slots, w), self.pad, np.int32)
+        for i, p in enumerate(props):
+            drafts_np[i, :len(p)] = p
+        t_window = time.perf_counter()
+        with trace.span("serve/verify-tick", width=int(w),
+                        active=sum(s is not None for s in self._slots)):
+            toks, n_emit, self._cache, self._token, self._pos, \
+                self._seen, self._done = spec.verify_step(int(w), greedy)(
+                    self.engine.params, self._cache, self._token,
+                    self._pos, jnp.arange(self.n_slots), self._temp,
+                    self._top_p, self._rep, self._seen, self._done,
+                    jnp.asarray(drafts_np), jnp.int32(self._tick_no),
+                    jnp.int32(self.eos), jnp.int32(self.pad))
+            self._tick_no += 1
+            tok_h = np.asarray(jax.device_get(toks))   # (slots, w+1)
+            n_h = np.asarray(jax.device_get(n_emit))   # (slots,)
+        self._m_ticks.inc(1)
+        appended = 0
+        accepted_total = 0
+        per_slot: List[int] = []
+        for i in range(self.n_slots):
+            act = self._slots[i]
+            if act is None:
+                continue
+            n_i = int(n_h[i])
+            acc_i = min(max(0, n_i - 1), len(props[i]))
+            per_slot.append(acc_i)
+            accepted_total += acc_i
+            for t in range(n_i):
+                tokv = int(tok_h[i, t])
+                act.emitted.append(tokv)
+                appended += 1
+                if (self.eos >= 0 and tokv == self.eos) or \
+                        len(act.emitted) >= act.req.max_new_tokens:
+                    self._retire(i)
+                    break
+        if appended:
+            self._note_tpot(time.perf_counter() - t_window, appended)
+        spec.note_verify(drafted, accepted_total, per_slot)
+        return True
+
+    # ------------------------------------------------------------------
     def step(self, ticks: int = 1) -> Dict[int, np.ndarray]:
         """Admit, decode up to ``ticks`` ticks, retire finished slots.
 
@@ -667,8 +805,14 @@ class ContinuousBatcher:
         round trip exactly as before — the idle-path throughput is
         untouched.  EOS retirements are only observed at sub-window
         boundaries (the done flag freezes the slot on device, so padding
-        is discarded, not mis-emitted).  Returns {uid: full token array}
-        for requests completed during this call."""
+        is discarded, not mis-emitted).
+
+        With a resolved speculative decoder (``specdec=``), iterations
+        take batched verify ticks in place of plain windows while the
+        acceptance controller allows: a verify tick counts as ONE tick
+        against ``ticks`` but may emit up to k+1 tokens per slot.
+        Returns {uid: full token array} for requests completed during
+        this call."""
         if ticks < 1:
             raise ValueError(f"ticks must be >= 1, got {ticks}")
         before = set(self._finished)
@@ -684,6 +828,16 @@ class ContinuousBatcher:
             self._update_occupancy_gauges()
             if not active:
                 break
+            greedy = all(a.req.temperature <= 0.0 for a in active)
+            # speculative verify tick (inference/specdec.py): one drafted
+            # k-wide verify forward in place of this iteration's window;
+            # counts as ONE tick.  _spec_tick returns False when no slot
+            # produced a draft — fall through to a plain window (k=0
+            # degenerates gracefully, never a wasted verify dispatch).
+            if self.specdec is not None and self.specdec.active() and \
+                    self._spec_tick(greedy):
+                remaining -= 1
+                continue
             sub = remaining
             if self._queue or self._parked:
                 t2r = min(a.req.max_new_tokens - len(a.emitted)
@@ -704,7 +858,7 @@ class ContinuousBatcher:
                     sub = min(1 << sub.bit_length(),
                               1 << (remaining.bit_length() - 1))
             slot_ids = jnp.arange(self.n_slots)
-            greedy = all(a.req.temperature <= 0.0 for a in active)
+            t_window = time.perf_counter()
             with trace.span("serve/decode-tick", ticks=int(sub),
                             active=len(active)):
                 toks, self._cache, self._token, self._pos, self._seen, \
@@ -719,15 +873,21 @@ class ContinuousBatcher:
                 # the fetch is part of the tick's host wall time
                 tok_h = np.asarray(jax.device_get(toks))[:, :, 0]
             self._m_ticks.inc(int(sub))
+            appended = 0
             for t in range(int(sub)):
                 for i, act in enumerate(self._slots):
                     if act is None:
                         continue
                     tokv = int(tok_h[t, i])
                     act.emitted.append(tokv)
+                    appended += 1
                     if (self.eos >= 0 and tokv == self.eos) or \
                             len(act.emitted) >= act.req.max_new_tokens:
                         self._retire(i)
+            if appended:
+                self._note_tpot(time.perf_counter() - t_window, appended)
+            if self.specdec is not None:
+                self.specdec.note_plain(int(sub))
             remaining -= int(sub)
         goodput.note_step("serving")   # /healthz last-step age
         new = {u: self._finished[u] for u in self._finished if u not in before}

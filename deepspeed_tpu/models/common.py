@@ -224,6 +224,22 @@ def cache_leaf_kind(path) -> Optional[str]:
     return None
 
 
+def set_cache_index(cache, value):
+    """Return ``cache`` with every ``cache_index`` leaf set to ``value``
+    (a scalar, possibly traced) — the ONE write-head rewind discipline
+    shared by serving placement/retire and the speculative-decoding
+    verify step (``inference/specdec.py``).  Rewinding through
+    :func:`cache_leaf_kind` instead of ad-hoc string matches means a
+    renamed leaf breaks loudly in one place, and the fused/unfused cache
+    layouts cannot drift apart."""
+    def leaf_fn(path, leaf):
+        if cache_leaf_kind(path) == "index":
+            return jnp.full_like(leaf, value)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(leaf_fn, cache)
+
+
 def append_kv_cache(module: nn.Module, k: jax.Array, v: jax.Array,
                     cache_len: int, dtype):
     """Append this step's K/V ``(B, S, H, D)`` into the module's mutable

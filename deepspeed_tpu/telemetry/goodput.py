@@ -50,6 +50,7 @@ SPAN_PHASE = {
     "train/checkpoint": "checkpoint",
     "serve/prefill": "compute",
     "serve/decode-tick": "compute",
+    "serve/verify-tick": "compute",   # speculative batched verify forward
     "serve/admission": "data_wait",
 }
 
