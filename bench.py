@@ -96,17 +96,25 @@ def bench_decode():
         model.init(jax.random.PRNGKey(0),
                    np.zeros((1, 8), np.int32))["params"],
         is_leaf=lambda x: hasattr(x, "names") and hasattr(x, "value"))
-    eng = deepspeed_tpu.init_inference(model=model, params=params,
-                                       max_tokens=192)   # 32+128 gen
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, size=(32,)).astype(np.int32)
                for _ in range(slots * 2)]
-    batcher = ContinuousBatcher(eng, n_slots=slots)
     ticks = 16   # decode ticks per host round-trip (tunnel RTT dominates)
-    batcher.run(prompts[:slots], max_new_tokens=4, ticks=ticks)  # warmup
-    t0 = time.perf_counter()
-    outs = batcher.run(prompts, max_new_tokens=new_toks, ticks=ticks)
-    dt = time.perf_counter() - t0
+
+    def measure():
+        # fresh engine+batcher per attempt: a flake mid-burst leaves
+        # donated caches and zombie slots behind — a retried run on the
+        # same batcher would either crash again or understate tok/s
+        # (the bench_serving run_variant pattern)
+        eng = deepspeed_tpu.init_inference(model=model, params=params,
+                                           max_tokens=192)   # 32+128 gen
+        batcher = ContinuousBatcher(eng, n_slots=slots)
+        batcher.run(prompts[:slots], max_new_tokens=4, ticks=ticks)  # warm
+        t0 = time.perf_counter()
+        outs = batcher.run(prompts, max_new_tokens=new_toks, ticks=ticks)
+        return outs, time.perf_counter() - t0
+
+    outs, dt = _retry(measure, "decode-measure")
     tokens = sum(len(o) - 32 for o in outs)
     print(json.dumps({
         "metric": f"{preset} batched decode tokens/sec ({slots} slots)",
@@ -198,8 +206,12 @@ def bench_serving():
                 "ttft_p90_ms": round(1000 * lat["ttft_p90_s"], 1)}
 
     out = {"model": preset, "slots": slots, "new_tokens": new_toks}
-    out["fp"] = run_variant({})
-    out["int8"] = run_variant({"enabled": True, "bits": 8})
+    # each variant pays a prefill+decode compile over the tunnel — the
+    # same flake class that voided round 3's training record; a retry
+    # re-runs from the XLA compile cache, so it costs ~one burst
+    out["fp"] = _retry(lambda: run_variant({}), "serving-fp")
+    out["int8"] = _retry(lambda: run_variant({"enabled": True, "bits": 8}),
+                         "serving-int8")
     if out["fp"]["decode_tok_s"]:
         out["int8_speedup"] = round(
             out["int8"]["decode_tok_s"] / out["fp"]["decode_tok_s"], 2)
@@ -225,9 +237,11 @@ def bench_serving():
     try:
         llama = {"model": "llama-700m-gqa(16h/4kv)" if on_tpu
                  else "llama-tiny"}
-        llama["fp"] = run_variant({}, make_model=make_llama)
-        llama["int8"] = run_variant({"enabled": True, "bits": 8},
-                                    make_model=make_llama)
+        llama["fp"] = _retry(lambda: run_variant({}, make_model=make_llama),
+                             "serving-llama-fp")
+        llama["int8"] = _retry(
+            lambda: run_variant({"enabled": True, "bits": 8},
+                                make_model=make_llama), "serving-llama-int8")
         if llama["fp"]["decode_tok_s"]:
             llama["int8_speedup"] = round(
                 llama["int8"]["decode_tok_s"] / llama["fp"]["decode_tok_s"],
@@ -240,7 +254,7 @@ def bench_serving():
         out["llama"] = {"error": repr(e)[:300]}
     if not os.environ.get("DS_TPU_BENCH_SKIP_MOE_SERVING"):
         try:
-            out["moe"] = bench_moe_serving()
+            out["moe"] = _retry(bench_moe_serving, "moe-serving")
         except Exception as e:
             out["moe"] = {"error": repr(e)[:200]}
     return out

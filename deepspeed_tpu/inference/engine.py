@@ -437,8 +437,10 @@ class InferenceEngine:
     @staticmethod
     def _seen_mask_from(input_ids, vocab_size: int):
         B = input_ids.shape[0]
+        # np.arange: a host index array — a jnp.arange here dispatches a
+        # device computation per admission (the PR-4 positions contract)
         return jnp.zeros((B, vocab_size), bool).at[
-            jnp.arange(B)[:, None], input_ids].set(True)
+            np.arange(B)[:, None], input_ids].set(True)
 
     def _zero_cache_fn(self, batch_size: int):
         """Memoized (per batch width) jitted zero-cache builder: the naive
@@ -496,7 +498,7 @@ class InferenceEngine:
                              f"(max_tokens/model context)")
         with trace.span("serve/prefill", rows=int(B), len=int(S)):
             cache = self.init_cache(B)
-            positions = jnp.arange(S)[None, :].repeat(B, 0)
+            positions = jnp.asarray(np.arange(S)[None, :].repeat(B, 0))
             logits, cache = self._compiled_prefill(
                 self.params, cache, input_ids, positions)
         rng = jax.random.PRNGKey(seed)
@@ -513,7 +515,7 @@ class InferenceEngine:
                         float(temperature), int(top_k), float(top_p),
                         rep_pen, seen)
         done = token == eos
-        seen = seen.at[jnp.arange(B), token].set(True)
+        seen = seen.at[np.arange(B), token].set(True)
         if compiled_loop and max_new_tokens > 1:
             loop = self._compiled_generate_loop(
                 int(top_k), float(top_p), float(temperature))
@@ -521,7 +523,8 @@ class InferenceEngine:
                             rows=int(B)):
                 toks = loop(self.params, cache, token[:, None],
                             jnp.full((B,), S, jnp.int32), rng, rep_pen, seen,
-                            done, eos, pad, jnp.arange(max_new_tokens - 1))
+                            done, eos, pad,
+                            jnp.asarray(np.arange(max_new_tokens - 1)))
             return jnp.concatenate([input_ids, token[:, None], toks.T], axis=1)
         decode_step = self._compiled_decode_step(
             int(top_k), float(top_p), float(temperature))

@@ -151,6 +151,7 @@ class PagedKVPool:
                     + m.page_shape[m.bdim + 1:])
 
         metas = sorted(self._meta.items())
+        # dstpu-lint: disable-next-line=DSTPU005 -- one-shot arena build at pool construction; the executable is intentionally single-use
         self.pages: Dict[str, jax.Array] = jax.jit(lambda: {
             k: jnp.zeros(arena_shape(m), m.dtype) for k, m in metas})()
         self.page_bytes = _page_bytes(self._meta)
@@ -211,21 +212,23 @@ class PagedKVPool:
 
         def run(pages, cache, pids, offs, n_tokens):
             def leaf_fn(path, leaf):
-                kind = model_common.cache_leaf_kind(path)
-                if kind == "index":
-                    return jnp.full_like(leaf, n_tokens)
+                if model_common.cache_leaf_kind(path) == "index":
+                    return leaf          # rewound below via set_cache_index
                 m = meta[jax.tree_util.keystr(path)]
                 tgt = leaf.shape[:m.tokdim] + (pt,) + leaf.shape[m.tokdim + 1:]
                 for i in range(w):
                     page = jax.lax.dynamic_index_in_dim(
                         pages[jax.tree_util.keystr(path)], pids[i],
                         axis=m.bdim, keepdims=True)
+                    # dstpu-lint: disable-next-line=DSTPU003 -- paged-pool page movement sits BELOW the append abstraction; offsets are page-aligned by construction and the layout is derived from cache_leaf_kind
                     leaf = jax.lax.dynamic_update_slice_in_dim(
                         leaf, jnp.broadcast_to(page, tgt).astype(leaf.dtype),
                         offs[i], axis=m.tokdim)
                 return leaf
 
-            return jax.tree_util.tree_map_with_path(leaf_fn, cache)
+            cache = jax.tree_util.tree_map_with_path(leaf_fn, cache)
+            # write head → match length through THE rewind discipline
+            return model_common.set_cache_index(cache, n_tokens)
 
         fn = recompile.watch(jax.jit(run, donate_argnums=(1,)),
                              name=f"serving.gather_pages[{w}]", warn=False)
@@ -266,6 +269,7 @@ class PagedKVPool:
                 for i in range(w):
                     chunk = jax.lax.dynamic_slice_in_dim(
                         src, offs[i], pt, axis=m.tokdim)
+                    # dstpu-lint: disable-next-line=DSTPU003 -- writes into the pool ARENA (page axis), not a model cache leaf; the arena layout is derived from the contract's page geometry
                     new[k] = jax.lax.dynamic_update_slice_in_dim(
                         new[k], chunk.astype(m.dtype), pids[i], axis=m.bdim)
             return new

@@ -304,6 +304,13 @@ class ContinuousBatcher:
             # leaf per request (round-4: ~0.5 s of every prefill batch)
             cache1, first1, seen1B_row = slice_parked_row(
                 cacheB, firstB, seen1B, row)
+            # bucket-padded prefill leaves the write head at the PADDED
+            # width with K/V garbage at [prompt_len, bucket): rewind to
+            # the real length so decode ticks overwrite the garbage in
+            # place — the attention length mask (cur+1) then never reads
+            # past the last real write.  Exact-length prefills rewind to
+            # the value already there (a no-op).
+            cache1 = model_common.set_cache_index(cache1, prompt_len)
             first = first1[0]
             seen1 = seen1B_row[0]
 
@@ -312,20 +319,7 @@ class ContinuousBatcher:
                     big, small[None].astype(big.dtype),
                     (i,) + (0,) * small.ndim)
 
-            def put_cache(path, big, small):
-                if model_common.cache_leaf_kind(path) == "index":
-                    # bucket-padded prefill leaves the write head at the
-                    # PADDED width with K/V garbage at [prompt_len,
-                    # bucket): rewind to the real length so decode ticks
-                    # overwrite the garbage in place — the attention
-                    # length mask (cur+1) then never reads past the last
-                    # real write.  Exact-length prefills rewind to the
-                    # value already there (a no-op).
-                    small = jnp.full_like(small, prompt_len)
-                return put(big, small)
-
-            cache = jax.tree_util.tree_map_with_path(put_cache, cache,
-                                                     cache1)
+            cache = jax.tree_util.tree_map(put, cache, cache1)
             token = put(token, first[:, None])
             pos = put(pos, jnp.int32(prompt_len))
             temp = put(temp, r_temp)
@@ -359,6 +353,7 @@ class ContinuousBatcher:
 
             def reset(path, leaf):
                 if model_common.cache_leaf_kind(path) == "index":
+                    # dstpu-lint: disable-next-line=DSTPU003 -- per-SLOT head rewind on the slot-stacked cache; set_cache_index rewinds every row (classified through cache_leaf_kind, same contract)
                     return leaf.at[i].set(0)
                 return leaf
 
@@ -569,8 +564,8 @@ class ContinuousBatcher:
                                                    cache=cacheB, start=m0)
                     # per-row REAL last-token logits (the pad positions'
                     # logits are sampling garbage)
-                    last = logits[jnp.arange(B),
-                                  jnp.asarray(lens) - 1][:, None]
+                    last = logits[np.arange(B),
+                                  np.asarray(lens) - 1][:, None]
                 else:   # uniform length: exact prefill, no pad compute
                     ids = jnp.asarray(np.stack([r.prompt[m0:]
                                                 for r in reqs]))
@@ -756,7 +751,7 @@ class ContinuousBatcher:
             toks, n_emit, self._cache, self._token, self._pos, \
                 self._seen, self._done = spec.verify_step(int(w), greedy)(
                     self.engine.params, self._cache, self._token,
-                    self._pos, jnp.arange(self.n_slots), self._temp,
+                    self._pos, np.arange(self.n_slots), self._temp,
                     self._top_p, self._rep, self._seen, self._done,
                     jnp.asarray(drafts_np), jnp.int32(self._tick_no),
                     jnp.int32(self.eos), jnp.int32(self.pad))
@@ -857,7 +852,7 @@ class ContinuousBatcher:
                     # placement fully overwrites.
                     sub = min(1 << sub.bit_length(),
                               1 << (remaining.bit_length() - 1))
-            slot_ids = jnp.arange(self.n_slots)
+            slot_ids = np.arange(self.n_slots)
             t_window = time.perf_counter()
             with trace.span("serve/decode-tick", ticks=int(sub),
                             active=len(active)):
@@ -925,7 +920,7 @@ class ContinuousBatcher:
         while s <= int(ticks):
             compiled = self._multi_step(s, greedy).lower(
                 self.engine.params, self._cache, self._token, self._pos,
-                jnp.arange(self.n_slots), self._temp, self._top_p,
+                np.arange(self.n_slots), self._temp, self._top_p,
                 self._rep, self._seen, self._done, jnp.int32(0),
                 jnp.int32(self.eos), jnp.int32(self.pad)).compile()
             # the AOT compile is the one place a Compiled handle exists:

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
 from ..ops.rotary import apply_rotary_pos_emb
-from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift_labels
+from .common import (ModelOutput, append_kv_cache, cross_entropy_loss,
+                     resolve_remat_policy, shift_labels)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,23 +136,11 @@ class GPTJAttention(nn.Module):
                                     interleaved=True)
         if cfg.decode:
             CL = cfg.cache_len or cfg.max_position_embeddings
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-            idx.value = cur + S
+            kc, vc, cur = append_kv_cache(self, k, v, CL, cfg.dtype)
             # shared fused-or-fallback dispatch (ops/attention.py)
             from ..ops.attention import cached_decode_attention
 
-            y = cached_decode_attention(q, ck.value, cv.value, cur,
-                                        attn_mask)
+            y = cached_decode_attention(q, kc, vc, cur, attn_mask)
         else:
             y = dot_product_attention(q, k, v, causal=True, mask=attn_mask,
                                       impl=cfg.attn_impl)

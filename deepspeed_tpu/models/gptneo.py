@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
-from .common import ModelOutput, cross_entropy_loss, resolve_remat_policy, shift_labels
+from .common import (ModelOutput, append_kv_cache, cross_entropy_loss,
+                     resolve_remat_policy, shift_labels)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,24 +145,13 @@ class NeoAttention(nn.Module):
 
         if cfg.decode:
             CL = cfg.cache_len or cfg.max_position_embeddings
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, CL, H, D), cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-            idx.value = cur + S
+            kc, vc, cur = append_kv_cache(self, k, v, CL, cfg.dtype)
             q_pos = cur + jnp.arange(S)[:, None]
-            k_pos = jnp.arange(cfg.max_position_embeddings)[None, :]
+            k_pos = jnp.arange(CL)[None, :]
             causal = k_pos <= q_pos
             window = causal & (k_pos > q_pos - cfg.window_size)
             mask = jnp.where(is_local, window, causal)[None, None, :, :]
-            y = dot_product_attention(q, ck.value, cv.value, causal=False,
+            y = dot_product_attention(q, kc, vc, causal=False,
                                       mask=mask, scale=1.0, impl="jnp")
         else:
             q_pos = jnp.arange(S)[:, None]

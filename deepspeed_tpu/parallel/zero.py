@@ -308,6 +308,7 @@ class Init:
                     lambda p: p.astype(self.dtype), params)
             return params
 
+        # dstpu-lint: disable-next-line=DSTPU005 -- one-shot sharded param init at engine construction; the executable is intentionally single-use
         return jax.jit(_init, out_shardings=shardings)(rng)
 
 

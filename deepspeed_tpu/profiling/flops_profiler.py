@@ -79,7 +79,13 @@ def module_flops_breakdown(fn: Callable, *args, depth: int = 3,
 
     if lowered is None:
         lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
-    txt = lowered.as_text(debug_info=True)
+    try:
+        txt = lowered.as_text(debug_info=True)
+    except TypeError:
+        # jax 0.4.x: as_text() has no debug_info kwarg (and prints no
+        # loc() breadcrumbs) — pull the annotated asm off the MLIR module
+        txt = lowered.compiler_ir().operation.get_asm(
+            enable_debug_info=True)
     # location table: #locN = loc("path"...) possibly chained
     import re
 
@@ -93,7 +99,7 @@ def module_flops_breakdown(fn: Callable, *args, depth: int = 3,
         return loc_ref
 
     def group(path: str) -> str:
-        path = re.sub(r"^jit\([^)]*\)/", "", path)
+        path = re.sub(r"^(jit\([^)]*\)/)+", "", path)
         segs = [s for s in path.split("/")
                 if s and not s.startswith(("jvp(", "transpose(", "remat",
                                            "checkpoint", "while", "body",

@@ -159,13 +159,21 @@ def sample_live_hbm(registry: Optional[_registry.Registry] = None) -> dict:
         pass
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
-        for src, name in (("bytes_in_use", "hbm_device_in_use_bytes"),
-                          ("peak_bytes_in_use", "hbm_device_peak_bytes"),
-                          ("bytes_limit", "hbm_device_limit_bytes")):
+
+        # literal names at the call sites so DSTPU006 sees the
+        # declarations; only declared when the backend reports the stat
+        # (CPU's memory_stats() is empty)
+        def gauge(name, src, desc):
             if src in stats:
-                reg.gauge(name, f"allocator {src} on device 0"
-                          ).set(float(stats[src]))
+                reg.gauge(name, desc).set(float(stats[src]))
                 out[name] = float(stats[src])
+
+        gauge("hbm_device_in_use_bytes", "bytes_in_use",
+              "allocator bytes_in_use on device 0")
+        gauge("hbm_device_peak_bytes", "peak_bytes_in_use",
+              "allocator peak_bytes_in_use on device 0")
+        gauge("hbm_device_limit_bytes", "bytes_limit",
+              "allocator bytes_limit on device 0")
     except Exception:
         pass
     return out

@@ -1,0 +1,8 @@
+"""Developer tooling that ships with the stack.
+
+``tools.lint`` is the dstpu-lint static analyzer: ``python -m
+deepspeed_tpu.tools.lint deepspeed_tpu/``.  The modules under ``tools``
+import only the stdlib — analysis is pure ``ast``, no jax — so the
+heaviest thing a lint run pays for is the parent package import the
+``-m`` entry point implies.
+"""

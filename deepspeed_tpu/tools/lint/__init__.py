@@ -1,0 +1,19 @@
+"""dstpu-lint: JAX-aware static analysis for the stack's own contracts.
+
+Usage::
+
+    python -m deepspeed_tpu.tools.lint deepspeed_tpu/ [--format=json]
+
+Programmatic::
+
+    from deepspeed_tpu.tools.lint import run_lint
+    result = run_lint(["deepspeed_tpu/"])
+    assert not result.active
+
+The rule set (DSTPU001-006) encodes the trace/donation/cache/telemetry
+contracts documented in ``docs/tutorials/static-analysis.md``; the
+framework (registry, suppressions, output) lives in
+:mod:`deepspeed_tpu.tools.lint.core`.
+"""
+from .core import (Finding, LintResult, Rule, all_rules,  # noqa: F401
+                   register, render_json, render_text, run_lint)

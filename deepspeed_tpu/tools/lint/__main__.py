@@ -1,0 +1,60 @@
+"""CLI for dstpu-lint — ``python -m deepspeed_tpu.tools.lint [paths...]``.
+
+Exit status: 0 when every finding is suppressed-with-reason (or there are
+none), 1 when unsuppressed findings remain, 2 on usage errors.  JSON mode
+(``--format=json``) emits the full machine-readable report including the
+suppression audit trail; CI gates on the exit status.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import all_rules, render_json, render_text, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.tools.lint",
+        description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                    help="files or directories to lint "
+                         "(default: deepspeed_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--docs", default=None,
+                    help="docs tree for DSTPU006 (default: auto-discover "
+                         "a docs/ dir next to the scanned path)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="text mode: also print suppressed findings")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, cls in sorted(all_rules().items()):
+            print(f"{rid}  {cls.name}")
+            for line in (cls.doc or "").split("\n"):
+                print(f"    {line.strip()}")
+        return 0
+
+    select = tuple(s.strip().upper() for s in args.select.split(",")
+                   if s.strip())
+    ignore = tuple(s.strip().upper() for s in args.ignore.split(",")
+                   if s.strip())
+    result = run_lint(args.paths or ["deepspeed_tpu"], select=select,
+                      ignore=ignore, docs=args.docs)
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, show_suppressed=args.show_suppressed))
+    return 0 if not result.active else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # e.g. `--list-rules | head`
+        sys.exit(0)

@@ -1,0 +1,344 @@
+"""dstpu-lint core: rule registry, suppression handling, runner, output.
+
+The stack's correctness rests on cross-layer contracts that no general
+linter knows about — positions-as-arguments, the ``append_kv_cache`` /
+``set_cache_index`` cache discipline, donation lifetimes, executable-cache
+hygiene, telemetry naming (see ``docs/tutorials/static-analysis.md``).
+This module is the machinery; the contracts live in
+:mod:`deepspeed_tpu.tools.lint.rules`.
+
+Pure stdlib (``ast`` + ``tokenize``): the analyzer must run in a bare CI
+job without the jax runtime.
+
+Suppression grammar (one comment per line, rules comma-separated, the
+justification after ``--`` is REQUIRED — an unexplained suppression is
+itself a finding):
+
+- ``# dstpu-lint: disable=DSTPU003 -- why this site is the exception``
+- ``# dstpu-lint: disable-next-line=DSTPU001 -- reason``
+- ``# dstpu-lint: disable-file=DSTPU006 -- reason`` (anywhere in the file)
+- ``# dstpu-lint: hotpath`` on a ``def`` line opts the function into the
+  hot-path rules (DSTPU002) in addition to the built-in path list.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import tokenize
+from io import StringIO
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# the framework's own diagnostics (parse failures, reason-less
+# suppressions) — reported under this id so they gate CI like any rule
+META_RULE = "DSTPU000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dstpu-lint:\s*(disable(?:-next-line|-file)?)\s*=\s*"
+    r"([A-Za-z0-9_,\s]+?)\s*(?:--\s*(.*))?$")
+_HOTPATH_RE = re.compile(r"#\s*dstpu-lint:\s*hotpath\b")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # path as given on the command line (relative)
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""   # the suppression's justification, when suppressed
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tail = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}{tail}"
+
+
+@dataclasses.dataclass
+class _Suppression:
+    rules: Tuple[str, ...]     # () means "all rules"
+    reason: str
+    line: int
+    file_wide: bool = False
+
+    def covers(self, rule: str) -> bool:
+        return not self.rules or rule in self.rules
+
+
+class FileContext:
+    """One parsed python file: source, AST, comment-derived metadata."""
+
+    def __init__(self, path: Path, display: str, src: str):
+        self.path = path
+        self.display = display
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(src)
+        except SyntaxError as e:
+            self.parse_error = e
+        # line -> suppressions (stacked disable-next-line comments can
+        # land several on one code line); file-wide ones separate
+        self.suppressions: Dict[int, List[_Suppression]] = {}
+        self.file_suppressions: List[_Suppression] = []
+        self.hotpath_lines: set = set()
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.src).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # fall back to a line scan so a half-broken file still honors
+            # its suppressions (strings containing '#' may false-match,
+            # which at worst over-suppresses a broken file)
+            comments = [(i + 1, line[line.index("#"):])
+                        for i, line in enumerate(self.lines) if "#" in line]
+        for line_no, text in comments:
+            if _HOTPATH_RE.search(text):
+                self.hotpath_lines.add(line_no)
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind, rules_s, reason = m.group(1), m.group(2), m.group(3) or ""
+            rules = tuple(r.strip().upper() for r in rules_s.split(",")
+                          if r.strip())
+            if any(r == "ALL" for r in rules):
+                rules = ()
+            sup = _Suppression(rules, reason.strip(), line_no,
+                               file_wide=(kind == "disable-file"))
+            if kind == "disable-file":
+                self.file_suppressions.append(sup)
+            elif kind == "disable-next-line":
+                self.suppressions.setdefault(
+                    self._next_code_line(line_no), []).append(sup)
+            else:
+                self.suppressions.setdefault(line_no, []).append(sup)
+
+    def _next_code_line(self, line_no: int) -> int:
+        """First non-blank, non-comment line after ``line_no`` — stacked
+        disable-next-line comments all bind to the statement they
+        precede, not to each other."""
+        for i in range(line_no, len(self.lines)):     # lines[i] = line i+1
+            s = self.lines[i].strip()
+            if s and not s.startswith("#"):
+                return i + 1
+        return line_no + 1
+
+    def suppression_for(self, rule: str, line: int) -> Optional[_Suppression]:
+        for sup in self.suppressions.get(line, ()):
+            if sup.covers(rule):
+                return sup
+        for fs in self.file_suppressions:
+            if fs.covers(rule):
+                return fs
+        return None
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.display, line, col, message)
+
+
+class Rule:
+    """Base rule.  Subclasses set ``id``/``name``/``doc`` and implement
+    ``check(ctx)`` (per python file).  Rules needing cross-file state
+    (DSTPU006) additionally implement ``collect(ctx)`` /
+    ``collect_doc(path, text)`` and ``finalize()``."""
+
+    id: str = ""
+    name: str = ""
+    doc: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def collect(self, ctx: FileContext) -> None:
+        pass
+
+    def collect_doc(self, path: Path, display: str, text: str) -> None:
+        pass
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    assert cls.id and cls.id not in _REGISTRY, cls
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, type]:
+    # import for side effect: the rule classes register on first use
+    from . import rules as _rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    files_checked: int
+    docs_checked: int
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_json(self) -> dict:
+        counts: Dict[str, int] = {}
+        for f in self.active:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "ok": not self.active,
+            "files_checked": self.files_checked,
+            "docs_checked": self.docs_checked,
+            "counts_by_rule": counts,
+            "findings": [f.to_json() for f in self.active],
+            "suppressed": [f.to_json() for f in self.suppressed],
+        }
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[Tuple[Path, str]]:
+    seen = set()
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files = sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            files = [p]
+        else:
+            files = []
+        for f in files:
+            if "__pycache__" in f.parts:
+                continue
+            r = f.resolve()
+            if r in seen:
+                continue
+            seen.add(r)
+            yield f, str(f)
+
+
+def _find_docs(paths: Sequence[str], docs: Optional[str]) -> List[Path]:
+    """Doc tree for DSTPU006: explicit ``--docs``, else ``docs/`` next to
+    (or one level above) the first scanned path."""
+    if docs is not None:
+        d = Path(docs)
+        return sorted(d.rglob("*.md")) if d.is_dir() else []
+    for raw in paths:
+        base = Path(raw).resolve()
+        if base.is_file():
+            base = base.parent
+        for root in (base, base.parent):
+            d = root / "docs"
+            if d.is_dir():
+                return sorted(d.rglob("*.md"))
+    return []
+
+
+def run_lint(paths: Sequence[str], *, select: Sequence[str] = (),
+             ignore: Sequence[str] = (),
+             docs: Optional[str] = None) -> LintResult:
+    """Lint ``paths`` (files or trees) with every registered rule.
+
+    ``select``/``ignore`` filter by rule id.  Suppression comments are
+    applied here — a suppressed finding stays in the result (JSON keeps
+    the audit trail) but does not affect the exit status.  A suppression
+    matching a finding but carrying no ``--`` justification raises a
+    DSTPU000 finding at the same line: the repo's contract is
+    suppress-WITH-reason."""
+    rule_classes = all_rules()
+    enabled = {rid: cls() for rid, cls in rule_classes.items()
+               if (not select or rid in select) and rid not in ignore}
+    findings: List[Finding] = []
+    contexts: List[FileContext] = []
+
+    files = list(_iter_py_files(paths))
+    for path, display in files:
+        try:
+            src = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(META_RULE, display, 0, 0,
+                                    f"unreadable file: {e}"))
+            continue
+        ctx = FileContext(path, display, src)
+        if ctx.parse_error is not None:
+            findings.append(Finding(
+                META_RULE, display, ctx.parse_error.lineno or 0, 0,
+                f"syntax error: {ctx.parse_error.msg}"))
+            continue
+        contexts.append(ctx)
+        for rule in enabled.values():
+            rule.collect(ctx)
+
+    doc_files = _find_docs(paths, docs)
+    for doc in doc_files:
+        try:
+            text = doc.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            continue
+        for rule in enabled.values():
+            rule.collect_doc(doc, str(doc), text)
+
+    for ctx in contexts:
+        for rule in enabled.values():
+            findings.extend(rule.check(ctx))
+    for rule in enabled.values():
+        findings.extend(rule.finalize())
+
+    # apply suppressions (cross-file rules anchor findings to real file
+    # contexts too, so look the context up by display path)
+    by_display = {ctx.display: ctx for ctx in contexts}
+    out: List[Finding] = []
+    flagged_reasonless: set = set()
+    for f in findings:
+        ctx = by_display.get(f.path)
+        sup = ctx.suppression_for(f.rule, f.line) if ctx else None
+        if sup is not None:
+            f.suppressed = True
+            f.reason = sup.reason
+            if not sup.reason:
+                key = (f.path, sup.line, sup.file_wide)
+                if key not in flagged_reasonless:
+                    flagged_reasonless.add(key)
+                    out.append(Finding(
+                        META_RULE, f.path, sup.line, 0,
+                        "suppression without a justification: append "
+                        "'-- <one-line reason>'"))
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(out, files_checked=len(files),
+                      docs_checked=len(doc_files))
+
+
+def render_text(result: LintResult, show_suppressed: bool = False) -> str:
+    lines = [f.render() for f in result.active]
+    if show_suppressed:
+        lines += [f.render() for f in result.suppressed]
+    n_act, n_sup = len(result.active), len(result.suppressed)
+    lines.append(
+        f"dstpu-lint: {result.files_checked} files, {result.docs_checked} "
+        f"docs; {n_act} finding{'s' if n_act != 1 else ''}"
+        f" ({n_sup} suppressed)")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.to_json(), indent=2, sort_keys=True)

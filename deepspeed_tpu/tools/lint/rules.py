@@ -1,0 +1,847 @@
+"""The dstpu-lint rule set: the stack's cross-layer contracts, as code.
+
+Each rule encodes an invariant this codebase has actually been burned by
+(see CHANGES.md review-round fixes and ``docs/tutorials/
+static-analysis.md`` for the war stories):
+
+- DSTPU001  eager ``jnp.*`` work at import time / in host scheduling code
+- DSTPU002  host-sync calls inside the serving/verify/drafter hot paths
+- DSTPU003  KV-cache writes or rewinds outside the ``models/common``
+            ``append_kv_cache`` / ``set_cache_index`` contract
+- DSTPU004  use of a buffer after it was donated to XLA
+- DSTPU005  recompile hazards (inline jit, jit-in-loop, per-call string
+            statics)
+- DSTPU006  telemetry names referenced in docs/code must be declared in
+            the registry
+
+Analysis is intentionally repo-aware: hot paths, contract files and
+device-call shapes are named below, because this linter's job is THIS
+stack's contracts, not general python hygiene.  False positives are
+expected to be rare and handled by ``# dstpu-lint: disable=RULE -- why``.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, Finding, Rule, register
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node) -> str:
+    """Best-effort dotted rendering of an expression: ``self._retire_fn``,
+    ``jax.lax.dynamic_update_slice``, ``spec.verify_step()`` (calls keep
+    ``()`` so patterns can anchor on them)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        base = dotted(node.func)
+        return f"{base}()" if base else ""
+    if isinstance(node, ast.Subscript):
+        base = dotted(node.value)
+        return f"{base}[]" if base else ""
+    return ""
+
+
+def _norm(display: str) -> str:
+    return display.replace("\\", "/")
+
+
+def _path_matches(display: str, globs: Sequence[str]) -> bool:
+    p = _norm(display)
+    return any(fnmatch.fnmatch(p, g) or p.endswith(g) for g in globs)
+
+
+class _Aliases:
+    """Per-file import aliases for numpy / jax.numpy / jax."""
+
+    def __init__(self, tree: ast.Module):
+        self.jnp: Set[str] = {"jax.numpy"}
+        self.np: Set[str] = {"numpy"}
+        self.jax: Set[str] = {"jax"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.numpy":
+                        self.jnp.add(a.asname or "jax.numpy")
+                    elif a.name == "numpy":
+                        self.np.add(a.asname or "numpy")
+                    elif a.name == "jax":
+                        self.jax.add(a.asname or "jax")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp.add(a.asname or "numpy")
+
+    def is_jnp(self, d: str) -> bool:
+        root = d.split(".")[0]
+        return root in self.jnp or d.startswith("jax.numpy.")
+
+    def is_np(self, d: str) -> bool:
+        return d.split(".")[0] in self.np
+
+    def is_jax(self, d: str) -> bool:
+        return d.split(".")[0] in self.jax
+
+
+_TRACED_DECORATORS = re.compile(
+    r"(^|\.)(jit|vmap|pmap|grad|value_and_grad|checkpoint|remat|scan|"
+    r"custom_vjp|custom_jvp|custom_vmap|compact|nowrap|kernel|"
+    r"shard_map)\b")
+
+
+class _Scopes(ast.NodeVisitor):
+    """Classify every function def as traced (device) or host code.
+
+    Traced: nested defs and lambdas (the repo's jitted functions are
+    closures built inside host constructors), anything decorated with a
+    jit/vmap/remat/compact-style transform, and the flax-traced methods
+    (``__call__``/``setup``) of module classes.  Everything else —
+    module-level defs and plain methods — is host code."""
+
+    def __init__(self, tree: ast.Module):
+        self.info: Dict[ast.AST, dict] = {}
+        self._stack: List[ast.AST] = []
+        self._class: List[ast.ClassDef] = []
+        self.visit(tree)
+
+    def _decorated_traced(self, node) -> bool:
+        return any(_TRACED_DECORATORS.search(dotted(d) or "")
+                   for d in node.decorator_list)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class.append(node)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_fn(self, node):
+        in_function = any(isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                          for s in self._stack)
+        is_method = (not in_function and self._class
+                     and any(node in c.body for c in self._class[-1:]))
+        traced = (in_function
+                  or self._decorated_traced(node)
+                  or (is_method and node.name in ("__call__", "setup")))
+        qual = ".".join([c.name for c in self._class[-1:]]
+                        + [node.name]) if is_method else node.name
+        self.info[node] = {"traced": traced, "method": is_method,
+                           "qualname": qual}
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+def _functions(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _enclosing_map(tree: ast.Module) -> Dict[ast.AST, Optional[ast.AST]]:
+    """node -> innermost enclosing function def."""
+    out: Dict[ast.AST, Optional[ast.AST]] = {}
+
+    def walk(node, fn):
+        out[node] = fn
+        nxt = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) else fn
+        for child in ast.iter_child_nodes(node):
+            walk(child, nxt)
+
+    walk(tree, None)
+    return out
+
+
+def _own_statements(fn) -> List[ast.stmt]:
+    """The function's statements in source order, NOT descending into
+    nested function/lambda bodies (those trace later, on device)."""
+    out: List[ast.stmt] = []
+
+    def walk(stmts):
+        for s in stmts:
+            out.append(s)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                walk(getattr(s, field, []) or [])
+            for h in getattr(s, "handlers", []) or []:
+                walk(h.body)
+
+    walk(fn.body)
+    return out
+
+
+def _expr_nodes(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Expression nodes belonging to THIS statement: child statements are
+    skipped (``_own_statements`` yields them separately — descending here
+    would double-report) and so are nested def/lambda bodies (traced)."""
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.stmt)):
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(stmt)
+
+
+# ---------------------------------------------------------------------------
+# DSTPU001 — eager jnp work at import time / in host scheduling code
+# ---------------------------------------------------------------------------
+
+# constructors that DISPATCH a device computation to build their result;
+# zeros/asarray and friends are deliberate transfers and stay legal in
+# host code (they are how operands reach the device at all)
+_COMPUTE_CONSTRUCTORS = ("arange", "linspace", "logspace", "eye", "tri",
+                        "indices", "meshgrid")
+
+# modules whose top-level functions and methods are host-side scheduling
+# code (everything else's top-level defs are traced library code called
+# from inside jit)
+_HOST_MODULES = ("*/inference/*.py", "*/runtime/engine.py", "*/launcher/*.py",
+                 "*/autotuning/*.py", "*/monitor/*.py", "*/telemetry/*.py",
+                 "*/elasticity/*.py", "*/utils/*.py", "*/profiling/*.py")
+
+
+@register
+class EagerJnpRule(Rule):
+    id = "DSTPU001"
+    name = "eager-jnp"
+    doc = ("Eager jnp.* calls at module import time force early backend "
+           "init; jnp.arange-style constructors in host scheduling code "
+           "dispatch a device computation per call — build with np.* and "
+           "transfer via jnp.asarray, or pass the values as arguments so "
+           "offset variants reuse one executable (the PR-4 positions "
+           "contract).")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = _Aliases(ctx.tree)
+        findings: List[Finding] = []
+
+        # (a) import-time scope: module body, class bodies, decorator
+        # expressions and default arguments — all executed at import
+        def import_time_exprs(body):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for d in stmt.decorator_list:
+                        yield d
+                    for dflt in (stmt.args.defaults
+                                 + [d for d in stmt.args.kw_defaults if d]):
+                        yield dflt
+                elif isinstance(stmt, ast.ClassDef):
+                    for d in stmt.decorator_list:
+                        yield d
+                    yield from import_time_exprs(stmt.body)
+                else:
+                    yield stmt
+
+        def eager_nodes(node):
+            """Walk, PRUNING def/lambda subtrees (their bodies run later,
+            on device) without abandoning sibling expressions — a lambda
+            in a dict must not hide an eager call after it."""
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                yield from eager_nodes(child)
+
+        for expr in import_time_exprs(ctx.tree.body):
+            if isinstance(expr, ast.Lambda):
+                continue        # e.g. a lambda default argument
+            for node in eager_nodes(expr):
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    if al.is_jnp(d) or (al.is_jax(d) and ".numpy." in d):
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"eager `{d}(...)` at import time initializes "
+                            f"the jax backend on module import; build host "
+                            f"constants with np.* (or defer into the "
+                            f"function that needs them)"))
+
+        # (b) host scheduling code: compute-producing constructors only
+        host_wide = _path_matches(ctx.display, _HOST_MODULES)
+        scopes = _Scopes(ctx.tree)
+        for fn in _functions(ctx.tree):
+            info = scopes.info.get(fn)
+            if info is None or info["traced"]:
+                continue
+            if not (host_wide or info["method"]):
+                continue   # top-level defs outside host modules: traced libs
+            for stmt in _own_statements(fn):
+                for node in _expr_nodes(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = dotted(node.func)
+                    if not al.is_jnp(d):
+                        continue
+                    leaf = d.split(".")[-1]
+                    if leaf in _COMPUTE_CONSTRUCTORS:
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"`{d}(...)` in host code `{info['qualname']}` "
+                            f"dispatches a device computation per call; "
+                            f"build with np.{leaf} and transfer via "
+                            f"jnp.asarray (hoisting it if reused), or pass "
+                            f"the values as a traced argument"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DSTPU002 — host syncs inside the serving/verify/drafter hot paths
+# ---------------------------------------------------------------------------
+
+# (path glob, function qualname glob): the serving tick, the speculative
+# verify tick, admission, and the drafters — one implicit sync here stalls
+# every slot in the pool.  `# dstpu-lint: hotpath` on a def line opts
+# additional functions in.
+_HOTPATHS: Tuple[Tuple[str, str], ...] = (
+    ("*/inference/serving.py", "ContinuousBatcher.step"),
+    ("*/inference/serving.py", "ContinuousBatcher._spec_tick"),
+    ("*/inference/serving.py", "ContinuousBatcher._admit"),
+    ("*/inference/serving.py", "ContinuousBatcher._prefill*"),
+    ("*/inference/serving.py", "ContinuousBatcher._shrink_parked"),
+    ("*/inference/serving.py", "ContinuousBatcher._retire"),
+    ("*/inference/specdec.py", "*.propose"),
+    ("*/inference/specdec.py", "SpecDecoder.note_*"),
+)
+
+# callees whose results live on device: the repo's jitted-executable
+# naming (slot/verify steps, admission fns, compiled prefill) plus raw
+# jax/jnp calls handled separately
+_DEVICE_CALL_RE = re.compile(
+    r"(_fn\b|_fn\(|_step\b|_steps\[|_multi_step|compiled|verify_step|"
+    r"\.apply\(|\.lower\(|_first_token_batch|_prefill\()")
+
+_SYNC_SUFFIXES = (".item", ".block_until_ready")
+
+
+@register
+class HostSyncRule(Rule):
+    id = "DSTPU002"
+    name = "hotpath-sync"
+    doc = ("Implicit host syncs (.item(), float()/int() on device arrays, "
+           "np.asarray on device arrays, block_until_ready) inside the "
+           "serving tick / verify / drafter hot paths serialize the "
+           "pipeline; the ONE sanctioned sync is an explicit "
+           "jax.device_get at the window boundary.")
+
+    def _is_hot(self, ctx: FileContext, fn, qualname: str) -> bool:
+        for pglob, qglob in _HOTPATHS:
+            if _path_matches(ctx.display, (pglob,)) and \
+                    fnmatch.fnmatch(qualname, qglob):
+                return True
+        first = fn.lineno
+        deco_first = min([d.lineno for d in fn.decorator_list] or [first])
+        return any(ln in ctx.hotpath_lines
+                   for ln in range(deco_first - 1, fn.body[0].lineno))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = _Aliases(ctx.tree)
+        scopes = _Scopes(ctx.tree)
+        findings: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            info = scopes.info.get(fn)
+            if info is None or info["traced"]:
+                continue
+            if not self._is_hot(ctx, fn, info["qualname"]):
+                continue
+            findings.extend(self._check_fn(ctx, al, fn, info["qualname"]))
+        return findings
+
+    # -- light intra-function taint: which names hold device arrays ----
+    def _device_expr(self, e, al: _Aliases, taint: Set[str]) -> bool:
+        if isinstance(e, ast.Call):
+            d = dotted(e.func)
+            if d.endswith("device_get") or al.is_np(d):
+                return False          # the sanctioned sync / host data
+            if al.is_jnp(d) or d.startswith(("jax.lax", "jax.random",
+                                             "jax.nn", "jax.tree_util")):
+                return True
+            if _DEVICE_CALL_RE.search(d + "("):
+                return True
+            return any(self._device_expr(a, al, taint) for a in e.args)
+        if isinstance(e, (ast.Name, ast.Attribute)):
+            return dotted(e) in taint
+        if isinstance(e, ast.Subscript):
+            return self._device_expr(e.value, al, taint)
+        return any(self._device_expr(c, al, taint)
+                   for c in ast.iter_child_nodes(e)
+                   if isinstance(c, ast.expr))
+
+    @staticmethod
+    def _targets(stmt) -> List[str]:
+        tgts: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            tgts = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            tgts = [stmt.target]
+        out: List[str] = []
+        for t in tgts:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out.extend(dotted(e) for e in t.elts)
+            else:
+                out.append(dotted(t))
+        return [t for t in out if t]
+
+    @staticmethod
+    def _is_metadata(arg) -> bool:
+        """len(x), x.shape[...], x.ndim, x.dtype — shape/meta reads, not
+        syncs."""
+        if isinstance(arg, ast.Call) and dotted(arg.func) == "len":
+            return True
+        d = dotted(arg)
+        return bool(re.search(r"\.(shape(\[\])?|ndim|dtype|size)$", d))
+
+    def _check_fn(self, ctx, al, fn, qual) -> Iterable[Finding]:
+        taint: Set[str] = set()
+        findings: List[Finding] = []
+        for stmt in _own_statements(fn):
+            for node in _expr_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d.endswith(_SYNC_SUFFIXES):
+                    recv = dotted(node.func.value) if isinstance(
+                        node.func, ast.Attribute) else ""
+                    if d.endswith(".block_until_ready") or recv in taint:
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"`{d}()` in hot path `{qual}` blocks the host "
+                            f"per call; batch results and fetch once with "
+                            f"jax.device_get at the window boundary"))
+                elif d == "block_until_ready":
+                    # the bare from-import form; dotted forms hit the
+                    # _SYNC_SUFFIXES branch above
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"block_until_ready in hot path `{qual}` blocks "
+                        f"the host per call; batch results and fetch once "
+                        f"with jax.device_get at the window boundary"))
+                elif d in ("float", "int", "bool") and node.args:
+                    a = node.args[0]
+                    if not self._is_metadata(a) and \
+                            self._device_expr(a, al, taint):
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"`{d}()` on a device value in hot path "
+                            f"`{qual}` is an implicit sync; jax.device_get "
+                            f"the batch once instead"))
+                elif al.is_np(d) and d.split(".")[-1] in ("asarray",
+                                                          "array") \
+                        and node.args:
+                    if self._device_expr(node.args[0], al, taint):
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"`{d}(...)` on a device value in hot path "
+                            f"`{qual}` syncs implicitly; wrap the fetch in "
+                            f"jax.device_get explicitly (one batched get "
+                            f"per window)"))
+            # taint update AFTER checks: this statement's targets
+            tgts = self._targets(stmt)
+            if tgts:
+                rhs = stmt.value if isinstance(
+                    stmt, (ast.Assign, ast.AnnAssign)) else getattr(
+                        stmt, "value", None)
+                is_dev = rhs is not None and self._device_expr(rhs, al, taint)
+                for t in tgts:
+                    (taint.add if is_dev else taint.discard)(t)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DSTPU003 — KV-cache writes outside the models/common contract
+# ---------------------------------------------------------------------------
+
+_CACHE_CONTRACT_FILE = ("*/models/common.py",)
+_CONTRACT_TOKENS = re.compile(
+    r"cache_leaf_kind|cached_key|cached_value|cache_index|KV_CACHE_LEAVES")
+_UPDATE_CALLS = re.compile(
+    r"dynamic_update_slice(_in_dim)?$|dynamic_update_index_in_dim$")
+
+
+@register
+class CacheContractRule(Rule):
+    id = "DSTPU003"
+    name = "kv-cache-contract"
+    doc = ("All KV-cache writes go through models/common.append_kv_cache "
+           "and all write-head rewinds through set_cache_index; ad-hoc "
+           "cache-leaf declarations or dynamic_update_slice/.at[].set/"
+           "full_like on cache leaves elsewhere will drift from the "
+           "fused/unfused layout contract (and from the paged pool's "
+           "derived geometry).")
+
+    def _own_text(self, ctx: FileContext, fn,
+                  enclosing: Dict[ast.AST, Optional[ast.AST]]) -> str:
+        """Source text of ``fn`` minus nested function bodies, so a parent
+        function is not blamed for its traced children's contract use."""
+        seg = ast.get_source_segment(ctx.src, fn) or ""
+        for other in _functions(ctx.tree):
+            if other is not fn and enclosing.get(other) is fn:
+                sub = ast.get_source_segment(ctx.src, other)
+                if sub:
+                    seg = seg.replace(sub, "")
+        return seg
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if _path_matches(ctx.display, _CACHE_CONTRACT_FILE):
+            return ()
+        findings: List[Finding] = []
+        enclosing = _enclosing_map(ctx.tree)
+        touches_contract: Dict[ast.AST, bool] = {}
+
+        def fn_touches(fn) -> bool:
+            if fn is None:
+                return False
+            if fn not in touches_contract:
+                touches_contract[fn] = bool(
+                    _CONTRACT_TOKENS.search(self._own_text(ctx, fn,
+                                                           enclosing)))
+            return touches_contract[fn]
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            # (a) ad-hoc cache collection declarations
+            if d.endswith(".variable") and len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value == "cache":
+                leaf = node.args[1].value if isinstance(
+                    node.args[1], ast.Constant) else "?"
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"ad-hoc cache leaf declaration "
+                    f"(variable('cache', {leaf!r})) outside models/common; "
+                    f"use append_kv_cache so the layout cannot drift from "
+                    f"the KV_CACHE_LEAVES contract"))
+                continue
+            # (b) update ops / index rewinds in functions that walk cache
+            # trees structurally
+            leaf_name = d.split(".")[-1]
+            is_update = bool(_UPDATE_CALLS.search(leaf_name))
+            is_at_update = leaf_name in ("set", "add") and ".at[]" in d
+            is_index_rewind = leaf_name == "full_like"
+            if not (is_update or is_at_update or is_index_rewind):
+                continue
+            fn = enclosing.get(node)
+            if isinstance(fn, ast.Lambda):
+                fn = enclosing.get(fn)
+            if fn_touches(fn):
+                what = ("cache write-head rewind" if is_index_rewind or
+                        is_at_update else "cache-leaf write")
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"{what} (`{d}`) in a function that walks the cache "
+                    f"tree, outside models/common; route writes through "
+                    f"append_kv_cache and rewinds through set_cache_index"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DSTPU004 — use after donation
+# ---------------------------------------------------------------------------
+
+
+@register
+class UseAfterDonationRule(Rule):
+    id = "DSTPU004"
+    name = "use-after-donation"
+    doc = ("An argument passed at a donate_argnums position is dead the "
+           "moment the call dispatches — XLA may alias its buffer for the "
+           "output.  Reading the donated variable afterwards (without "
+           "rebinding it, typically to the call's own result) returns "
+           "garbage on hardware even when CPU tests pass.")
+
+    def _donating_callables(self, ctx: FileContext) -> Dict[str, Tuple[int, ...]]:
+        """Names bound (directly or through wrappers like recompile.watch)
+        to a jax.jit(..., donate_argnums=...) result, with the donated
+        positions."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            rhs = node.value
+            if rhs is None:
+                continue
+            donated: Optional[Tuple[int, ...]] = None
+            for call in ast.walk(rhs):
+                if isinstance(call, ast.Call) and \
+                        dotted(call.func).endswith("jit"):
+                    for kw in call.keywords:
+                        if kw.arg == "donate_argnums":
+                            vals = []
+                            for c in ast.walk(kw.value):
+                                if isinstance(c, ast.Constant) and \
+                                        isinstance(c.value, int):
+                                    vals.append(c.value)
+                            donated = tuple(vals)
+            if donated:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    name = dotted(t)
+                    if name:
+                        out[name] = donated
+        return out
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        donators = self._donating_callables(ctx)
+        if not donators:
+            return ()
+        findings: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            findings.extend(self._check_fn(ctx, fn, donators))
+        return findings
+
+    def _check_fn(self, ctx, fn, donators) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        stmts = _own_statements(fn)
+        # dotted name -> (donation line, callee) for currently-dead values
+        dead: Dict[str, Tuple[int, str]] = {}
+        for stmt in stmts:
+            assigned = HostSyncRule._targets(stmt)
+            # reads first: a read of a dead name in this statement fires
+            # unless this statement merely rebinds it without reading
+            for node in _expr_nodes(stmt):
+                if isinstance(node, (ast.Name, ast.Attribute)) and \
+                        isinstance(getattr(node, "ctx", None), ast.Load):
+                    d = dotted(node)
+                    if d in dead:
+                        line, callee = dead[d]
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"`{d}` was donated to `{callee}` on line "
+                            f"{line} and read afterwards; donated buffers "
+                            f"may be aliased by XLA — rebind the name to "
+                            f"the call's result (or copy before donating)"))
+                        del dead[d]   # one report per donation
+            for name in assigned:
+                dead.pop(name, None)
+            # then record this statement's donations
+            for node in _expr_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted(node.func)
+                positions = donators.get(callee)
+                if positions is None:
+                    continue
+                for pos in positions:
+                    if pos < len(node.args):
+                        d = dotted(node.args[pos])
+                        if d and d not in assigned:
+                            dead[d] = (node.lineno, callee)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DSTPU005 — recompile hazards
+# ---------------------------------------------------------------------------
+
+
+@register
+class RecompileHazardRule(Rule):
+    id = "DSTPU005"
+    name = "recompile-hazard"
+    doc = ("Each jax.jit object owns its executable cache: constructing "
+           "one inline (jax.jit(f)(x)) or inside a loop retraces every "
+           "call; a per-call string (f-string/str()/format) passed to a "
+           "jitted callable is a distinct static value per call — every "
+           "distinct value compiles a new executable (the recompile "
+           "watchdog fires at runtime; this catches it at review time).")
+
+    _JIT_RE = re.compile(r"(^|\.)(jit|pmap)$")
+    _MEMO_DECOS = re.compile(r"(lru_cache|cache\b)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        enclosing = _enclosing_map(ctx.tree)
+        donators = UseAfterDonationRule()._donating_callables(ctx)
+        jitted_names = set(donators)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                    node.value is not None:
+                if any(isinstance(c, ast.Call)
+                       and self._JIT_RE.search(dotted(c.func))
+                       for c in ast.walk(node.value)):
+                    for t in (node.targets if isinstance(node, ast.Assign)
+                              else [node.target]):
+                        d = dotted(t)
+                        if d:
+                            jitted_names.add(d)
+
+        loops: List[ast.AST] = [n for n in ast.walk(ctx.tree)
+                                if isinstance(n, (ast.For, ast.While))]
+        in_loop: Set[ast.AST] = set()
+        for loop in loops:
+            for sub in ast.walk(loop):
+                in_loop.add(sub)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            # (a) inline-invoked jit: jax.jit(f)(x)
+            if isinstance(node.func, ast.Call) and \
+                    self._JIT_RE.search(dotted(node.func.func)):
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "jax.jit constructed and invoked inline — the "
+                    "executable cache is discarded after the call and "
+                    "every call retraces; bind the jitted callable once "
+                    "(init-time attribute or lru_cached factory)"))
+                continue
+            # (b) jit constructed inside a loop (unless the enclosing
+            # factory is memoized, the repo's per-width executable idiom)
+            if self._JIT_RE.search(d) and node in in_loop:
+                fn = enclosing.get(node)
+                memoized = fn is not None and any(
+                    self._MEMO_DECOS.search(dotted(deco))
+                    for deco in getattr(fn, "decorator_list", []))
+                if not memoized:
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        "jax.jit constructed inside a loop: each iteration "
+                        "builds a fresh executable cache; hoist the jit (or "
+                        "memoize the factory per static key, pow2-bucketed)"))
+                continue
+            # (c) per-call strings into jitted callables.  Callee match is
+            # deliberately narrower than DSTPU002's taint patterns, and
+            # telemetry-labelling kwargs (name=/site=/label=) are host
+            # metadata, not statics of the executable.
+            if d in jitted_names or re.search(r"_compiled_|\.lower\($",
+                                              d + "("):
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg not in ("name", "site", "label",
+                                          "reason", "help")]:
+                    if isinstance(arg, ast.JoinedStr) or (
+                            isinstance(arg, ast.Call)
+                            and dotted(arg.func) in ("str", "format")
+                            or isinstance(arg, ast.Call)
+                            and dotted(arg.func).endswith(".format")):
+                        findings.append(ctx.finding(
+                            self.id, arg,
+                            f"per-call string built in the signature of "
+                            f"jitted callable `{d}`: every distinct value "
+                            f"is a new static — key executables on bounded "
+                            f"(pow2-bucketed) values instead"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DSTPU006 — telemetry-name consistency
+# ---------------------------------------------------------------------------
+
+_METRIC_DECLS = ("counter", "gauge", "histogram")
+_UNIT_SUFFIXES = ("total", "seconds", "ms", "bytes", "ratio", "rate", "len",
+                  "depth", "slots", "info", "arrays", "port", "unixtime")
+_NAME_SHAPE = re.compile(r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)+$")
+_BACKTICK = re.compile(r"`([a-z][a-z0-9_]+)`")
+
+
+@register
+class TelemetryNamesRule(Rule):
+    id = "DSTPU006"
+    name = "telemetry-names"
+    doc = ("Every metric name referenced in docs/tutorials or in code "
+           "(flight-recorder pulls, dashboards) must exist in a registry "
+           "declaration (telemetry_registry.counter/gauge/histogram): a "
+           "renamed metric otherwise leaves dashboards silently empty.  "
+           "f-string declarations count as wildcard patterns.")
+
+    def __init__(self):
+        self.declared: Set[str] = set()
+        self.patterns: List[re.Pattern] = []
+        self.decl_prefixes: Set[str] = set()
+        # (display, line, name, where) to validate once declarations are
+        # fully collected
+        self.refs: List[Tuple[str, int, str, str]] = []
+        self._decl_sites: Set[Tuple[str, int]] = set()
+
+    # -- collection ----------------------------------------------------
+    def collect(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d.split(".")[-1] in _METRIC_DECLS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    self.declared.add(arg.value)
+                    self._decl_sites.add((ctx.display, arg.lineno))
+                elif isinstance(arg, ast.JoinedStr):
+                    pat = ""
+                    for part in arg.values:
+                        if isinstance(part, ast.Constant):
+                            pat += re.escape(str(part.value))
+                        else:
+                            pat += r"[a-z0-9_]+"
+                    self.patterns.append(re.compile(pat + r"\Z"))
+                    self._decl_sites.add((ctx.display, arg.lineno))
+                # bare Name args are forwarding wrappers (registry.py's
+                # module-level counter()/gauge()) — not declarations
+        # code references: metric-shaped string literals anywhere else
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _NAME_SHAPE.match(node.value) and \
+                    (ctx.display, node.lineno) not in self._decl_sites:
+                self.refs.append((ctx.display, node.lineno, node.value,
+                                  "code"))
+
+    def collect_doc(self, path: Path, display: str, text: str) -> None:
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _BACKTICK.finditer(line):
+                name = m.group(1)
+                if _NAME_SHAPE.match(name):
+                    self.refs.append((display, i, name, "doc"))
+
+    # -- validation ------------------------------------------------------
+    def _is_metric_shaped(self, name: str) -> bool:
+        """Only tokens that are unambiguously metric names are checked:
+        first segment must match a declared family prefix AND the last
+        segment must be a unit suffix — config keys like
+        train_micro_batch_size_per_gpu stay out of scope."""
+        parts = name.split("_")
+        return parts[0] in self.decl_prefixes and \
+            parts[-1] in _UNIT_SUFFIXES
+
+    def finalize(self) -> Iterable[Finding]:
+        self.decl_prefixes = {n.split("_")[0] for n in self.declared}
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+        for display, line, name, where in self.refs:
+            if not self._is_metric_shaped(name):
+                continue
+            if name in self.declared:
+                continue
+            if any(p.match(name) for p in self.patterns):
+                continue
+            key = (display, line, name)
+            if key in seen:
+                continue
+            seen.add(key)
+            src = "docs" if where == "doc" else "code"
+            findings.append(Finding(
+                self.id, display, line, 0,
+                f"metric `{name}` referenced in {src} has no registry "
+                f"declaration (counter/gauge/histogram) — fix the name or "
+                f"declare it; dashboards reading it would stay empty"))
+        return findings

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Run dstpu-lint on a bare python — no jax required.
+
+``python -m deepspeed_tpu.tools.lint`` imports the ``deepspeed_tpu``
+package ``__init__`` (which imports jax); CI's ``lint`` job deliberately
+installs nothing, so this shim loads the lint package directly by file
+path instead. Same CLI::
+
+    python scripts/run_lint.py deepspeed_tpu/ --format=json
+"""
+import importlib.util
+import pathlib
+import sys
+
+
+def load_lint_package():
+    pkg_dir = (pathlib.Path(__file__).resolve().parents[1]
+               / "deepspeed_tpu" / "tools" / "lint")
+    spec = importlib.util.spec_from_file_location(
+        "dstpu_lint", pkg_dir / "__init__.py",
+        submodule_search_locations=[str(pkg_dir)])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["dstpu_lint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    load_lint_package()
+    from dstpu_lint.__main__ import main
+
+    sys.exit(main())

@@ -34,6 +34,14 @@ def test_resolve_policy_names():
         resolve_remat_policy("nothing_saveable+offload")
 
 
+def _has_host_placement(jaxpr: str) -> bool:
+    """Host-placed residuals render as ``f32<host>`` on newer jax and as
+    ``memory_kind='pinned_host'`` TransferToMemoryKind annotations on
+    0.4.x — accept either so the assertion tracks the semantics, not one
+    version's pretty-printer."""
+    return "<host>" in jaxpr or "pinned_host" in jaxpr
+
+
 def _grad_jaxpr(policy_name):
     pol = resolve_remat_policy(policy_name)
 
@@ -51,8 +59,8 @@ def _grad_jaxpr(policy_name):
 def test_offload_policy_places_residuals_on_host():
     """+offload must move saved dot residuals to host memory (the jaxpr
     shows ``f32<host>`` device_puts); the plain policy must not."""
-    assert "<host>" in _grad_jaxpr("dots_saveable+offload")
-    assert "<host>" not in _grad_jaxpr("dots_saveable")
+    assert _has_host_placement(_grad_jaxpr("dots_saveable+offload"))
+    assert not _has_host_placement(_grad_jaxpr("dots_saveable"))
 
 
 def test_engine_cpu_checkpointing_config():
@@ -91,7 +99,7 @@ def test_engine_cpu_checkpointing_config():
         lambda p: eng._loss_fn(p, eng.prepare_batch(batch),
                                jax.random.PRNGKey(0),
                                deterministic=True)))(eng._state.params))
-    assert "<host>" in jaxpr
+    assert _has_host_placement(jaxpr)
 
 
 def test_functional_checkpoint_api_offload():
@@ -107,7 +115,7 @@ def test_functional_checkpoint_api_offload():
 
     jaxpr = str(jax.make_jaxpr(jax.grad(
         lambda x: jnp.sum(ac.checkpoint(blk, x))))(jnp.ones((32, 32))))
-    assert "<host>" in jaxpr
+    assert _has_host_placement(jaxpr)
     ac.configure(checkpoint_in_cpu=False)
     ac._config.enabled = False
 

@@ -13,6 +13,45 @@ from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
 from .simple_model import token_batch
 
 
+def _partial_manual_axis_index_lowers() -> bool:
+    """The PP engine runs shard_map manual over ``pp`` only (ZeRO/TP/DP
+    stay automatic) and reads ``lax.axis_index`` inside — legacy (0.4.x)
+    partial-auto shard_map lowers that to a bare PartitionId, which XLA's
+    SPMD partitioner rejects ("PartitionId instruction is not supported
+    for SPMD partitioning").  Probe the exact shape once; genuinely
+    environment-specific (current jax lowers it fine), same root cause as
+    the ``__graft_entry__`` self-test failure."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.utils import compat
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return True
+    mesh = Mesh(np.asarray(devs[:8]).reshape(2, 4), ("pp", "dp"))
+    try:
+        jax.jit(compat.shard_map(
+            lambda a: a + jax.lax.axis_index("pp"), mesh=mesh,
+            in_specs=P(), out_specs=P(), check_vma=False,
+            axis_names={"pp"})).lower(jnp.zeros((2,), jnp.int32)).compile()
+        return True
+    except Exception as e:
+        # ONLY the known lowering gap may skip; anything else (a compat
+        # shim regression, a real in-repo bug) must fail loudly
+        if "PartitionId" in repr(e):
+            return False
+        raise
+
+
+if not _partial_manual_axis_index_lowers():
+    pytest.skip(
+        "legacy partial-auto shard_map cannot lower axis_index "
+        "(XLA 'PartitionId instruction is not supported' — pre-existing, "
+        "environment-specific; passes on current jax)",
+        allow_module_level=True)
+
+
 @pytest.fixture(autouse=True)
 def fresh_mesh():
     mesh_mod.set_mesh(None)
