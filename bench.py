@@ -19,7 +19,8 @@ better than DeepSpeed used its.  The 1.5B block reports its own
 ``vs_baseline`` by the same MFU normalization.
 
 Other modes: ``--mode decode`` (continuous-batching serving),
-``--mode northstar`` (1.5B only).
+``--mode northstar`` (1.5B only), ``--mode serving_load``
+(trace-driven goodput under SLO vs SERVE_LOAD_BASELINE.json).
 """
 import argparse
 import json
@@ -258,6 +259,85 @@ def bench_serving():
         except Exception as e:
             out["moe"] = {"error": repr(e)[:200]}
     return out
+
+
+def bench_serving_load():
+    """``bench.py --mode serving_load``: trace-driven **goodput under
+    SLO** through the ContinuousBatcher (telemetry/loadgen.py) — the
+    serving analog of the training JSON line.  One-shot burst numbers
+    (``--mode serving``) measure steady-state throughput; this replays a
+    seeded open-loop traffic trace (Poisson arrivals, mixed prompt
+    lengths, shared-prefix traffic, Zipf generation lengths) and counts
+    only requests meeting machine-calibrated p99 TTFT/TPOT bounds.
+
+    When ``SERVE_LOAD_BASELINE.json`` is present its embedded trace
+    config is replayed (so the number is comparable to the CI gate) and
+    ``vs_baseline`` is SLO attainment relative to the recorded run;
+    ``extra.gate`` carries the regression-gate verdict.  The whole
+    build/warmup/calibrate/best-of-N pipeline is ``scripts/loadgen.py``'s
+    ``run_load`` — ONE implementation, so the bench row and the CI gate
+    can never judge with different SLO scaling."""
+    from deepspeed_tpu.telemetry import loadgen
+    from scripts import loadgen as loadgen_cli
+
+    baseline = None
+    bpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "SERVE_LOAD_BASELINE.json")
+    if os.path.exists(bpath):
+        with open(bpath) as fh:
+            baseline = json.load(fh)
+    if baseline is not None:
+        tcfg = loadgen.trace_config_from_dict(baseline["trace_config"])
+        preset = baseline.get("model", "gpt2-tiny")
+        slots = int(baseline.get("slots", 4))
+        ticks = int(baseline.get("ticks", 4))
+        prefix_cache = bool(baseline.get("prefix_cache", False))
+    else:   # compact CPU-mesh scenario (the baseline's shape)
+        tcfg = loadgen.TraceConfig(
+            n_requests=24, rate_rps=4.0,
+            prompt_len_mix=((8, 0.6), (16, 0.4)),
+            shared_prefix_ratio=0.25, shared_prefix_len=8,
+            gen_len_max=12, vocab_size=512, max_total_len=64)
+        preset, slots, ticks, prefix_cache = "gpt2-tiny", 4, 4, False
+    cli_args = argparse.Namespace(
+        model=preset, slots=slots, ticks=ticks,
+        max_total=tcfg.max_total_len or 64, prefix_cache=prefix_cache,
+        slo_ttft_ms=None, slo_tpot_ms=None, passes=2, time_scale=1.0)
+
+    # run_load builds a fresh engine+batcher per call, so _retry's
+    # re-invocation gets clean state (the bench_decode pattern: a flake
+    # mid-replay leaves donated caches / zombie slots behind)
+    report = _retry(
+        lambda: loadgen_cli.run_load(
+            cli_args, tcfg,
+            calibration=(baseline or {}).get("calibration"))[0],
+        "serving-load")
+    g = report.goodput
+    extra = {
+        "model": preset, "slots": slots, "ticks": ticks,
+        "trace_sha256": report.trace_sha256,
+        "offered": report.offered, "completed": report.completed,
+        "wall_s": report.wall_s,
+        "slo": g["slo"],
+        "slo_attainment": g["slo_attainment"],
+        "goodput_rps": g["goodput_rps"],
+        "goodput_token_ratio": g["goodput_token_ratio"],
+        "total_tok_s": g["total_tok_s"],
+        "ttft_p50_ms": g["ttft_p50_ms"], "ttft_p99_ms": g["ttft_p99_ms"],
+        "tpot_p50_ms": g["tpot_p50_ms"], "tpot_p99_ms": g["tpot_p99_ms"],
+    }
+    vs = None
+    if baseline is not None:
+        ok, msgs = loadgen.check_baseline(report.to_jsonable(), baseline)
+        extra["gate"] = {"ok": ok, "msgs": msgs}
+        recorded = (baseline.get("recorded") or {}).get("slo_attainment")
+        if recorded:
+            vs = round((g["slo_attainment"] or 0.0) / recorded, 3)
+    return {
+        "metric": f"{preset} serving goodput under SLO ({slots} slots, "
+                  f"trace {report.trace_sha256[:8]})",
+        "value": g["goodput_tok_s"], "unit": "tokens/s",
+        "vs_baseline": vs, "extra": extra}
 
 
 def bench_moe_serving():
@@ -604,11 +684,15 @@ def bench_train():
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode",
-                    choices=["train", "decode", "northstar", "serving"],
+                    choices=["train", "decode", "northstar", "serving",
+                             "serving_load"],
                     default="train")
     cli, _ = ap.parse_known_args()
     if cli.mode == "decode":
         return bench_decode()
+    if cli.mode == "serving_load":
+        print(json.dumps(bench_serving_load()), flush=True)
+        return
     if cli.mode == "northstar":
         print(json.dumps(bench_northstar()), flush=True)
         return

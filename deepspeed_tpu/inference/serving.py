@@ -32,6 +32,7 @@ import numpy as np
 from ..models import common as model_common
 from ..telemetry import (goodput, memory as telemetry_memory, recompile,
                          registry as telemetry_registry, trace)
+from ..telemetry.registry import pct as _pct
 from . import kvreuse
 from . import specdec as specdec_mod
 from .engine import InferenceEngine, _sample
@@ -71,7 +72,7 @@ class ContinuousBatcher:
                  pad_token_id: Optional[int] = None, seed: int = 0,
                  chunked_prefill: bool = True,
                  prefill_ahead: Optional[int] = None,
-                 prefix_cache=None, specdec=None):
+                 prefix_cache=None, specdec=None, slo=None):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
@@ -181,6 +182,33 @@ class ContinuousBatcher:
         self._m_parked_bytes = telemetry_registry.gauge(
             "serving_parked_bytes",
             "bytes pinned by parked prefill caches (deduped by buffer)")
+        # retire-time SLO tagging (telemetry/loadgen.py sets the bounds
+        # for load runs; any deployment can set them via ``slo=`` /
+        # ``set_slo``): a request that finished but blew its latency
+        # budget is counted as a violation, the substrate of the
+        # goodput-under-SLO report.  Registry counters are process-wide;
+        # the per-instance ints feed /statusz (cross-batcher pollution).
+        self._m_slo_met = telemetry_registry.counter(
+            "serving_slo_met_total",
+            "retired requests meeting the configured TTFT/TPOT SLO")
+        self._m_slo_viol = telemetry_registry.counter(
+            "serving_slo_violations_total",
+            "retired requests violating the configured SLO",
+            labelnames=("bound",))
+        self._slo_ttft_ms: Optional[float] = None
+        self._slo_tpot_ms: Optional[float] = None
+        self._slo_met_n = 0
+        self._slo_viol_n = 0
+        if slo is not None:
+            self.set_slo(getattr(slo, "ttft_ms", None)
+                         if not isinstance(slo, dict) else slo.get("ttft_ms"),
+                         getattr(slo, "tpot_ms", None)
+                         if not isinstance(slo, dict) else slo.get("tpot_ms"))
+        # per-request lifecycle observers (telemetry/loadgen.py): each
+        # gets (t, uid, event, extra) at submit / prefill_start /
+        # first_token / place / emit / retire.  Empty list = zero cost
+        # on the hot path (one truthiness check).
+        self._lifecycle_observers: List = []
         self._m_prefill_tokens = telemetry_registry.counter(
             "serving_prefill_tokens_total",
             "tokens run through prefill (padding included — compute, "
@@ -382,6 +410,7 @@ class ContinuousBatcher:
                                    temperature, top_p, repetition_penalty))
         self._t_submit[uid] = time.perf_counter()
         self._m_submitted.inc()
+        self._note_lifecycle(uid, "submit", queued=len(self._queue))
         self._update_occupancy_gauges()
         return uid
 
@@ -406,8 +435,48 @@ class ContinuousBatcher:
                 parked_bytes += telemetry_memory.tree_bytes(entry[1])
         self._m_parked_bytes.set(float(parked_bytes))
 
+    # -- per-request lifecycle + SLO ----------------------------------
+    def add_lifecycle_observer(self, fn):
+        """Register ``fn(t, uid, event, extra)`` for every request
+        lifecycle event; returns a zero-arg remover.  Events: ``submit``,
+        ``prefill_start`` (extra: hit_tokens/prefill_tokens/batch),
+        ``first_token``, ``place`` (extra: slot), ``emit`` (extra:
+        kind=decode|verify, n), ``retire`` (extra: n_out, ttft_ms,
+        tpot_ms, slo_ok).  Per uid, ``retire`` is always the LAST
+        event — a pending emit window is flushed before it — so an
+        observer may finalize a request's record at retire."""
+        self._lifecycle_observers.append(fn)
+
+        def remove():
+            if fn in self._lifecycle_observers:
+                self._lifecycle_observers.remove(fn)
+        return remove
+
+    def _note_lifecycle(self, uid: int, event: str, **extra) -> None:
+        if not self._lifecycle_observers:
+            return
+        t = time.perf_counter()
+        for fn in list(self._lifecycle_observers):
+            try:
+                fn(t, uid, event, extra)
+            except Exception:
+                pass            # an observer must never break serving
+
+    def set_slo(self, ttft_ms: Optional[float],
+                tpot_ms: Optional[float]) -> None:
+        """Configure (or clear, with None) the retire-time SLO bounds:
+        TTFT = submit → first token, TPOT = first token → retirement per
+        output token, both milliseconds."""
+        self._slo_ttft_ms = None if ttft_ms is None else float(ttft_ms)
+        self._slo_tpot_ms = None if tpot_ms is None else float(tpot_ms)
+
+    def _active_uids(self) -> List[int]:
+        return [a.req.uid for a in self._slots if a is not None]
+
     def _telemetry_status(self) -> dict:
         """The ``/statusz`` ``serving`` section (telemetry/exporter.py)."""
+        ttfts = sorted(t for t, _ in self._lat if t == t)
+        tpots = sorted(self._tpot_window)
         return {
             "n_slots": self.n_slots,
             "active_slots": sum(s is not None for s in self._slots),
@@ -422,8 +491,24 @@ class ContinuousBatcher:
             "parked_bytes": int(self._m_parked_bytes.value),
             "prefix_cache": self.prefix_cache is not None,
             "specdec": self.specdec is not None,
+            "in_flight_uids": self._active_uids(),
             "tpot_ms": None if not self._tpot_window else round(
                 sum(self._tpot_window) / len(self._tpot_window), 3),
+            # tail percentiles from the SAME bounded windows the load
+            # report reads, so /statusz and loadgen agree on tail latency
+            "tpot_p50_ms": None if not tpots else round(
+                _pct(tpots, 0.50), 3),
+            "tpot_p99_ms": None if not tpots else round(
+                _pct(tpots, 0.99), 3),
+            "ttft_p99_ms": None if not ttfts else round(
+                1e3 * _pct(ttfts, 0.99), 3),
+            "slo": None if self._slo_ttft_ms is None
+            and self._slo_tpot_ms is None else {
+                "ttft_ms": self._slo_ttft_ms,
+                "tpot_ms": self._slo_tpot_ms,
+                "met": self._slo_met_n,
+                "violated": self._slo_viol_n,
+            },
         }
 
     def _note_tpot(self, wall_s: float, tokens: int) -> None:
@@ -433,7 +518,7 @@ class ContinuousBatcher:
         self._tpot_window.append(ms)
 
     # ------------------------------------------------------------------
-    def _prefill(self, ids, cache=None, start: int = 0):
+    def _prefill(self, ids, cache=None, start: int = 0, uids=None):
         """Prefill of ``ids`` (B, S) — B prompts of equal length — into
         ``cache`` (a fresh B-row cache when None) at positions
         ``[start, start + S)``.
@@ -461,8 +546,12 @@ class ContinuousBatcher:
             raise ValueError(
                 f"offset prefill (start={start}) requires the cache that "
                 f"already holds positions [0, {start}); pass cache=")
+        # ``uids`` (the admitted requests' ids) land in the span args and
+        # therefore in the flight recorder's span ring: a crash mid-
+        # prefill names the requests it was admitting
         with trace.span("serve/prefill", rows=int(ids.shape[0]), len=int(S),
-                        start=int(start)):
+                        start=int(start),
+                        **({"uids": list(uids)} if uids else {})):
             if cache is None:
                 cache = eng.init_cache(ids.shape[0])
             self._m_prefill_tokens.inc(int(ids.shape[0]) * int(S))
@@ -545,6 +634,14 @@ class ContinuousBatcher:
             # suffix IS the whole prompt and everything below reduces to
             # the pre-existing path
             lens = np.asarray([len(r.prompt) - m0 for r in reqs], np.int32)
+            # lifecycle: the queue→prefill boundary, with the prefix-
+            # cache outcome (hit_tokens=0 ⇒ miss) — the waterfall's
+            # "queued" phase ends here for every request in the group
+            for row, r in enumerate(reqs):
+                self._note_lifecycle(r.uid, "prefill_start",
+                                     hit_tokens=int(m0),
+                                     prefill_tokens=int(lens[row]),
+                                     batch=B)
             cacheB = None
             try:
                 if m0:
@@ -560,8 +657,9 @@ class ContinuousBatcher:
                     ids_np = np.full((B, bucket), self.pad, np.int32)
                     for row, r in enumerate(reqs):
                         ids_np[row, :lens[row]] = r.prompt[m0:]
-                    logits, cacheB = self._prefill(jnp.asarray(ids_np),
-                                                   cache=cacheB, start=m0)
+                    logits, cacheB = self._prefill(
+                        jnp.asarray(ids_np), cache=cacheB, start=m0,
+                        uids=[r.uid for r in reqs])
                     # per-row REAL last-token logits (the pad positions'
                     # logits are sampling garbage)
                     last = logits[np.arange(B),
@@ -569,8 +667,9 @@ class ContinuousBatcher:
                 else:   # uniform length: exact prefill, no pad compute
                     ids = jnp.asarray(np.stack([r.prompt[m0:]
                                                 for r in reqs]))
-                    logits, cacheB = self._prefill(ids, cache=cacheB,
-                                                   start=m0)
+                    logits, cacheB = self._prefill(
+                        ids, cache=cacheB, start=m0,
+                        uids=[r.uid for r in reqs])
                     last = logits[:, -1:, :]
             finally:
                 if m0:
@@ -594,6 +693,7 @@ class ContinuousBatcher:
             t_first = time.perf_counter()
             for row, req in enumerate(reqs):
                 self._t_first[req.uid] = t_first
+                self._note_lifecycle(req.uid, "first_token")
                 first_host = int(first_hostB[row])
                 if first_host == self.eos or req.max_new_tokens <= 1:
                     self._finish_unslotted(req, [first_host])
@@ -604,9 +704,11 @@ class ContinuousBatcher:
                     (req, cacheB, row, firstB, seen1B, first_host))
         self._update_occupancy_gauges()
 
-    def _record_latency(self, uid: int) -> None:
+    def _record_latency(self, uid: int, n_out: int = 0) -> None:
         """Collapse a retired request's in-flight timestamps into the
-        bounded (ttft, e2e) window and the registry histograms."""
+        bounded (ttft, e2e) window and the registry histograms, tag the
+        retirement against the configured SLO (``set_slo``), and emit
+        the ``retire`` lifecycle event."""
         t_sub = self._t_submit.pop(uid, None)
         t_first = self._t_first.pop(uid, None)
         self._m_completed.inc()
@@ -618,11 +720,38 @@ class ContinuousBatcher:
         self._lat.append((ttft, e2e))
         self._m_ttft.observe(ttft)   # NaN observations are dropped
         self._m_e2e.observe(e2e)
+        ttft_ms = ttft * 1e3
+        # decode-phase per-output-token latency; None for single-token
+        # requests (no decode phase to bound)
+        tpot_ms = None
+        if t_first is not None and n_out > 1:
+            tpot_ms = (now - t_first) * 1e3 / (n_out - 1)
+        slo_ok: Optional[bool] = None
+        if self._slo_ttft_ms is not None or self._slo_tpot_ms is not None:
+            slo_ok = True
+            if self._slo_ttft_ms is not None and \
+                    not (ttft_ms <= self._slo_ttft_ms):   # NaN violates
+                slo_ok = False
+                self._m_slo_viol.labels(bound="ttft").inc()
+            if self._slo_tpot_ms is not None and tpot_ms is not None \
+                    and tpot_ms > self._slo_tpot_ms:
+                slo_ok = False
+                self._m_slo_viol.labels(bound="tpot").inc()
+            if slo_ok:
+                self._m_slo_met.inc()
+                self._slo_met_n += 1
+            else:
+                self._slo_viol_n += 1
+        self._note_lifecycle(uid, "retire", n_out=int(n_out),
+                             ttft_ms=round(ttft_ms, 3),
+                             tpot_ms=None if tpot_ms is None
+                             else round(tpot_ms, 4),
+                             slo_ok=slo_ok)
 
     def _finish_unslotted(self, req: Request, emitted: List[int]):
         self._finished[req.uid] = np.concatenate(
             [req.prompt, np.asarray(emitted, np.int32)])
-        self._record_latency(req.uid)
+        self._record_latency(req.uid, n_out=len(emitted))
         self._update_occupancy_gauges()
 
     def _admit(self):
@@ -643,6 +772,7 @@ class ContinuousBatcher:
                     cacheB, firstB, seen1B, row, len(req.prompt), i,
                     req.temperature, req.top_p, req.repetition_penalty)
             self._slots[i] = _Active(req, [first_host])
+            self._note_lifecycle(req.uid, "place", slot=i)
         self._shrink_parked()
         self._update_occupancy_gauges()
 
@@ -670,7 +800,7 @@ class ContinuousBatcher:
         act = self._slots[i]
         self._finished[act.req.uid] = np.concatenate(
             [act.req.prompt, np.asarray(act.emitted, np.int32)])
-        self._record_latency(act.req.uid)
+        self._record_latency(act.req.uid, n_out=len(act.emitted))
         self._slots[i] = None
         if self.prefix_cache is not None:
             # donate the prompt-prefix pages BEFORE retire_fn: retire
@@ -747,7 +877,8 @@ class ContinuousBatcher:
             drafts_np[i, :len(p)] = p
         t_window = time.perf_counter()
         with trace.span("serve/verify-tick", width=int(w),
-                        active=sum(s is not None for s in self._slots)):
+                        active=sum(s is not None for s in self._slots),
+                        uids=self._active_uids()):
             toks, n_emit, self._cache, self._token, self._pos, \
                 self._seen, self._done = spec.verify_step(int(w), greedy)(
                     self.engine.params, self._cache, self._token,
@@ -770,14 +901,24 @@ class ContinuousBatcher:
             acc_i = min(max(0, n_i - 1), len(props[i]))
             per_slot.append(acc_i)
             accepted_total += acc_i
+            emitted_i = 0
+            retire_slot = False
             for t in range(n_i):
                 tokv = int(tok_h[i, t])
                 act.emitted.append(tokv)
                 appended += 1
+                emitted_i += 1
                 if (self.eos >= 0 and tokv == self.eos) or \
                         len(act.emitted) >= act.req.max_new_tokens:
-                    self._retire(i)
+                    retire_slot = True
                     break
+            # emit precedes retire — observers may treat retire as
+            # terminal for the uid
+            if emitted_i:
+                self._note_lifecycle(act.req.uid, "emit", kind="verify",
+                                     n=emitted_i)
+            if retire_slot:
+                self._retire(i)
         if appended:
             self._note_tpot(time.perf_counter() - t_window, appended)
         spec.note_verify(drafted, accepted_total, per_slot)
@@ -855,7 +996,8 @@ class ContinuousBatcher:
             slot_ids = np.arange(self.n_slots)
             t_window = time.perf_counter()
             with trace.span("serve/decode-tick", ticks=int(sub),
-                            active=len(active)):
+                            active=len(active),
+                            uids=self._active_uids()):
                 toks, self._cache, self._token, self._pos, self._seen, \
                     done = self._multi_step(int(sub), greedy)(
                         self.engine.params, self._cache, self._token,
@@ -869,6 +1011,7 @@ class ContinuousBatcher:
                 tok_h = np.asarray(jax.device_get(toks))[:, :, 0]
             self._m_ticks.inc(int(sub))
             appended = 0
+            emitted_by_uid: Dict[int, int] = {}
             for t in range(int(sub)):
                 for i, act in enumerate(self._slots):
                     if act is None:
@@ -876,15 +1019,33 @@ class ContinuousBatcher:
                     tokv = int(tok_h[t, i])
                     act.emitted.append(tokv)
                     appended += 1
+                    if self._lifecycle_observers:
+                        emitted_by_uid[act.req.uid] = \
+                            emitted_by_uid.get(act.req.uid, 0) + 1
                     if (self.eos >= 0 and tokv == self.eos) or \
                             len(act.emitted) >= act.req.max_new_tokens:
+                        # flush this request's emit BEFORE retire —
+                        # observers may treat retire as terminal
+                        n_emit = emitted_by_uid.pop(act.req.uid, 0)
+                        if n_emit:
+                            self._note_lifecycle(act.req.uid, "emit",
+                                                 kind="decode", n=n_emit)
                         self._retire(i)
+            if self._lifecycle_observers:
+                for uid, n_emit in emitted_by_uid.items():
+                    self._note_lifecycle(uid, "emit", kind="decode",
+                                         n=n_emit)
             if appended:
                 self._note_tpot(time.perf_counter() - t_window, appended)
             if self.specdec is not None:
                 self.specdec.note_plain(int(sub))
             remaining -= int(sub)
-        goodput.note_step("serving")   # /healthz last-step age
+        in_flight = self._active_uids()
+        # /healthz last-step age; the in-flight uids ride the flight
+        # recorder's counter-delta context so a postmortem names the
+        # requests that were on the pool when the process died
+        goodput.note_step("serving",
+                          context={"uids": in_flight} if in_flight else None)
         new = {u: self._finished[u] for u in self._finished if u not in before}
         return new
 
@@ -976,17 +1137,22 @@ class ContinuousBatcher:
     def latency_stats(self) -> Dict[str, float]:
         """Per-request latency percentiles over the retired-request
         window (last ≤4096): ``ttft`` (submit → first token on host,
-        covers queueing + prefill) and ``e2e`` (submit → retirement).
-        Seconds."""
+        covers queueing + prefill) and ``e2e`` (submit → retirement),
+        seconds; plus decode-window TPOT percentiles (ms per output
+        token, from the same bounded window ``/statusz`` reads)."""
         ttfts = sorted(t for t, _ in self._lat if t == t)
         e2es = sorted(e for _, e in self._lat)
-
-        def pct(xs, q):
-            return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("nan")
+        tpots = sorted(self._tpot_window)
 
         stats = {"n": len(self._lat),
-                 "ttft_p50_s": pct(ttfts, 0.50), "ttft_p90_s": pct(ttfts, 0.90),
-                 "e2e_p50_s": pct(e2es, 0.50), "e2e_p90_s": pct(e2es, 0.90)}
+                 "ttft_p50_s": _pct(ttfts, 0.50),
+                 "ttft_p90_s": _pct(ttfts, 0.90),
+                 "ttft_p99_s": _pct(ttfts, 0.99),
+                 "e2e_p50_s": _pct(e2es, 0.50),
+                 "e2e_p90_s": _pct(e2es, 0.90),
+                 "e2e_p99_s": _pct(e2es, 0.99),
+                 "tpot_p50_ms": _pct(tpots, 0.50),
+                 "tpot_p99_ms": _pct(tpots, 0.99)}
         # mirror the percentile view into the registry (histograms carry
         # the full distributions; these gauges are the human-named cut)
         for key, value in stats.items():

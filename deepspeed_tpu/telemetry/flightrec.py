@@ -105,10 +105,14 @@ class FlightRecorder:
                 out[m.name] = sum(c.value for _, c in m.samples())
         return out
 
-    def mark(self, label: str = "") -> None:
+    def mark(self, label: str = "", context: Optional[dict] = None) -> None:
         """Record counter movement since the previous mark (throttled to
         one per second — wired off ``goodput.note_step`` and the
-        heartbeat, so a busy loop costs a dict diff per second)."""
+        heartbeat, so a busy loop costs a dict diff per second).
+
+        ``context`` (small JSON-ables — e.g. the serving loop's in-flight
+        request uids) is stored on the delta entry, so a postmortem can
+        name WHOSE work the counters were moving for at crash time."""
         now = time.monotonic()
         with self._mark_lock:
             if now - self._last_mark < _MARK_MIN_INTERVAL_S:
@@ -119,8 +123,10 @@ class FlightRecorder:
         delta = {k: round(v - prev.get(k, 0.0), 6)
                  for k, v in cur.items() if v != prev.get(k, 0.0)}
         if delta:
-            self.deltas.append({"t": time.time(), "label": label,
-                                "deltas": delta})
+            entry = {"t": time.time(), "label": label, "deltas": delta}
+            if context:
+                entry["ctx"] = context
+            self.deltas.append(entry)
 
     # -- dumping ---------------------------------------------------------
     def dump(self, reason: str, exc: Optional[BaseException] = None
@@ -202,9 +208,9 @@ def disarm() -> None:
     _recorder = None
 
 
-def mark(label: str = "") -> None:
+def mark(label: str = "", context: Optional[dict] = None) -> None:
     if _recorder is not None:
-        _recorder.mark(label)
+        _recorder.mark(label, context)
 
 
 def dump(reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
@@ -323,7 +329,23 @@ def pretty(path_or_payload, max_spans: int = 8, max_logs: int = 8) -> str:
         lines.append("  recent metric deltas:")
         for d in deltas:
             ago = round(t_dump - d["t"], 3)
-            lines.append(f"    -{ago}s {d.get('label', '')} {d['deltas']}")
+            ctx = f" ctx={d['ctx']}" if d.get("ctx") else ""
+            lines.append(f"    -{ago}s {d.get('label', '')} "
+                         f"{d['deltas']}{ctx}")
+    # in-flight request attribution: the last serving mark's context and
+    # any span args carrying uids name the requests on the pool at death
+    in_flight = None
+    for d in reversed(p.get("metric_deltas", [])):
+        if (d.get("ctx") or {}).get("uids"):
+            in_flight = d["ctx"]["uids"]
+            break
+    if in_flight is None:
+        for s in reversed(p.get("spans", [])):
+            if (s.get("args") or {}).get("uids"):
+                in_flight = s["args"]["uids"]
+                break
+    if in_flight:
+        lines.append(f"  in-flight request uids at last mark: {in_flight}")
     key = {}
     for name in ("train_steps_total", "serving_decode_ticks_total",
                  "serving_requests_completed_total", "xla_recompiles_total",

@@ -142,9 +142,12 @@ class GoodputTracker:
         self._span_phase.setdefault(f"goodput/{name}", name)
         return _trace.span(f"goodput/{name}")
 
-    def note_step(self, kind: str = "train") -> None:
+    def note_step(self, kind: str = "train",
+                  context: Optional[dict] = None) -> None:
         """Record that a step/tick completed — powers the ``/healthz``
-        last-step-age check and the flight recorder's metric-delta marks."""
+        last-step-age check and the flight recorder's metric-delta marks.
+        ``context`` (small JSON-ables, e.g. serving's in-flight request
+        uids) rides the flight-recorder delta entry for postmortems."""
         with self._lock:
             self._last_step_mono = time.monotonic()
             self._last_step_wall = time.time()
@@ -152,7 +155,7 @@ class GoodputTracker:
         try:
             from . import flightrec
 
-            flightrec.mark(kind)
+            flightrec.mark(kind, context)
         except Exception:
             pass
 
@@ -231,8 +234,8 @@ def note_compile(dur_s: float) -> None:
     get_tracker().note_compile(dur_s)
 
 
-def note_step(kind: str = "train") -> None:
-    get_tracker().note_step(kind)
+def note_step(kind: str = "train", context: Optional[dict] = None) -> None:
+    get_tracker().note_step(kind, context)
 
 
 def last_step_age() -> Optional[float]:

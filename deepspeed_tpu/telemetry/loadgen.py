@@ -1,0 +1,738 @@
+"""Traffic-trace load harness: seeded trace generation, replay against
+the continuous batcher, and goodput-under-SLO reporting.
+
+Every serving feature so far (fused decode kernels, prefix-cache reuse,
+speculative decoding) has been judged by one-shot probes — steady-state
+single-stream throughput, which is NOT what "heavy traffic from millions
+of users" looks like.  Serving-systems work evaluates with trace-driven
+load and p99-bounded goodput: requests arrive on their own clock
+(Poisson or bursty), prompt lengths are mixed, a fraction of traffic
+shares a system prompt, and generation lengths are long-tailed.  A
+request that finishes but blew its latency budget is not useful work.
+
+This module provides the measurement substrate:
+
+- :func:`generate_trace` — a DETERMINISTIC, seeded traffic trace
+  (:class:`TraceConfig` → :class:`Trace`): Poisson or Markov-modulated
+  bursty arrivals, a prompt-length mixture, an exactly-honored
+  shared-prefix ratio (exercises the radix prefix cache), and Zipf
+  long-tail generation lengths.  Same seed ⇒ byte-identical trace
+  (``Trace.sha256()`` is the regression-gate anchor).
+- :func:`replay` — drive a ``ContinuousBatcher`` with the trace in open
+  loop (arrivals never wait on completions), collecting a per-request
+  lifecycle waterfall (submit → queue → prefix-cache hit/miss → prefill
+  → first token → decode/verify → retire) via the batcher's lifecycle
+  observer hook, a queue-depth timeline, and per-phase goodput
+  attribution from :mod:`.goodput`.
+- :func:`compute_goodput` — **goodput under SLO**: tokens/s and
+  requests/s counted only for requests meeting the TTFT/TPOT bounds,
+  plus SLO attainment % and tail percentiles (the same percentile
+  convention ``ContinuousBatcher.latency_stats`` uses, so /statusz and
+  the load report agree).
+- :func:`calibrate_slo` — machine-relative SLO bounds (a multiple of
+  the box's own unloaded TTFT/TPOT), so the CI gate is portable across
+  runner speeds while still catching scheduling regressions.
+- :func:`check_baseline` — the regression gate: exact-match the trace
+  hash and total output tokens (determinism drift is a failure in its
+  own right), and fail when SLO attainment or the goodput token ratio
+  drops beyond tolerance vs a checked-in baseline
+  (``SERVE_LOAD_BASELINE.json``; see ``scripts/loadgen.py --gate``).
+
+CLI: ``scripts/loadgen.py`` (see ``--help``); compact bench block:
+``bench.py --mode serving_load``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import goodput as goodput_mod
+from . import registry as telemetry_registry
+
+__all__ = [
+    "TraceConfig", "TraceRequest", "Trace", "generate_trace",
+    "trace_config_from_dict", "SLOConfig", "compute_goodput", "pct",
+    "LifecycleCollector", "LoadReport", "replay", "calibrate_slo",
+    "check_baseline",
+]
+
+
+# ----------------------------------------------------------------------
+# trace generation
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Seeded workload description.  Every field is part of the trace
+    identity: the generator is a pure function of this config, and the
+    regression baseline embeds the config so the gate always replays
+    the exact trace it was recorded against."""
+
+    seed: int = 0
+    n_requests: int = 32
+    # arrival process: "poisson" (exponential inter-arrivals at
+    # ``rate_rps``) or "bursty" (two-state Markov-modulated Poisson:
+    # calm at ``rate_rps``, bursts at ``burst_rate_rps``, switching
+    # per-arrival with the enter/exit probabilities)
+    arrival: str = "poisson"
+    rate_rps: float = 8.0
+    burst_rate_rps: Optional[float] = None      # default: 4 × rate_rps
+    burst_enter_p: float = 0.08
+    burst_exit_p: float = 0.25
+    # prompt-length mixture: ((length, weight), ...); per-request jitter
+    # of ±``prompt_len_jitter`` (uniform, fractional) around the drawn
+    # mode keeps lengths mixed without losing the modes
+    prompt_len_mix: Tuple[Tuple[int, float], ...] = (
+        (16, 0.5), (48, 0.3), (128, 0.2))
+    prompt_len_jitter: float = 0.25
+    # exactly round(shared_prefix_ratio * n_requests) requests start
+    # with ONE shared prefix of ``shared_prefix_len`` tokens (the
+    # radix-prefix-cache workload); membership is a seeded permutation
+    shared_prefix_ratio: float = 0.0
+    shared_prefix_len: int = 16
+    # generation lengths: gen_len_min - 1 + Zipf(a), clamped to
+    # [gen_len_min, gen_len_max] — a long tail of big generations over
+    # a mass of short ones
+    gen_len_min: int = 2
+    gen_len_max: int = 64
+    gen_len_zipf_a: float = 2.2
+    vocab_size: int = 512
+    # clamp prompt_len + gen_len to the engine's generation limit; None
+    # disables (the replay would raise on an oversized request)
+    max_total_len: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TraceRequest:
+    idx: int
+    arrival_s: float
+    prompt: np.ndarray            # (S,) int32
+    max_new_tokens: int
+    shared_prefix: bool
+    regime: str                   # "calm" | "burst"
+
+    def to_jsonable(self) -> dict:
+        return {
+            "idx": self.idx,
+            # float.hex(): byte-exact round-trip — repr-based shortest
+            # floats are stable too, but hex makes the determinism
+            # contract explicit
+            "arrival_s": float(self.arrival_s).hex(),
+            "prompt": [int(t) for t in self.prompt],
+            "max_new_tokens": int(self.max_new_tokens),
+            "shared_prefix": bool(self.shared_prefix),
+            "regime": self.regime,
+        }
+
+
+@dataclasses.dataclass
+class Trace:
+    config: TraceConfig
+    requests: List[TraceRequest]
+
+    def to_jsonable(self) -> dict:
+        return {"config": dataclasses.asdict(self.config),
+                "requests": [r.to_jsonable() for r in self.requests]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_jsonable(), sort_keys=True,
+                          separators=(",", ":"))
+
+    def sha256(self) -> str:
+        return hashlib.sha256(self.to_json().encode()).hexdigest()
+
+    @property
+    def total_prompt_tokens(self) -> int:
+        return sum(len(r.prompt) for r in self.requests)
+
+    @property
+    def total_max_new_tokens(self) -> int:
+        return sum(r.max_new_tokens for r in self.requests)
+
+
+def trace_config_from_dict(d: dict) -> TraceConfig:
+    """Rebuild a :class:`TraceConfig` from its JSON form (the baseline
+    file embeds one so the gate always replays the recorded trace).
+    JSON turns the mixture tuples into lists; normalize them back —
+    the dataclass must hash/compare equal to the original."""
+    kw = dict(d)
+    if "prompt_len_mix" in kw:
+        kw["prompt_len_mix"] = tuple(
+            (int(length), float(weight))
+            for length, weight in kw["prompt_len_mix"])
+    unknown = set(kw) - {f.name for f in
+                         dataclasses.fields(TraceConfig)}
+    if unknown:
+        raise ValueError(f"unknown TraceConfig fields {sorted(unknown)}")
+    return TraceConfig(**kw)
+
+
+def generate_trace(cfg: TraceConfig) -> Trace:
+    """Deterministic trace from ``cfg`` (same config ⇒ byte-identical
+    output; see ``Trace.sha256``)."""
+    if cfg.arrival not in ("poisson", "bursty"):
+        raise ValueError(f"unknown arrival process {cfg.arrival!r}; "
+                         f"one of ('poisson', 'bursty')")
+    if not cfg.prompt_len_mix:
+        raise ValueError("prompt_len_mix must be non-empty")
+    if cfg.rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {cfg.rate_rps}")
+    if (cfg.shared_prefix_ratio > 0 and cfg.max_total_len is not None
+            and cfg.max_total_len < cfg.shared_prefix_len + 2):
+        # the truncation below would strip the guaranteed unique suffix
+        # token (prompt[:max_total_len-1] of a shared-prefix prompt is a
+        # pure prefix slice) — kvreuse's exact-match cap needs a real
+        # last token through prefill, so reject rather than silently
+        # emit degenerate identical prompts
+        raise ValueError(
+            f"max_total_len={cfg.max_total_len} leaves no room for a "
+            f"unique suffix token + 1 generated token after a "
+            f"{cfg.shared_prefix_len}-token shared prefix; need >= "
+            f"{cfg.shared_prefix_len + 2}")
+    rng = np.random.default_rng(cfg.seed)
+    n = int(cfg.n_requests)
+    burst_rate = (cfg.burst_rate_rps if cfg.burst_rate_rps is not None
+                  else 4.0 * cfg.rate_rps)
+
+    # -- arrivals (one pass; regime switches evaluated per arrival) ----
+    arrivals: List[float] = []
+    regimes: List[str] = []
+    t = 0.0
+    state = "calm"
+    for _ in range(n):
+        rate = cfg.rate_rps if state == "calm" else burst_rate
+        t += float(rng.exponential(1.0 / rate))
+        arrivals.append(t)
+        regimes.append(state)
+        if cfg.arrival == "bursty":
+            if state == "calm" and rng.random() < cfg.burst_enter_p:
+                state = "burst"
+            elif state == "burst" and rng.random() < cfg.burst_exit_p:
+                state = "calm"
+
+    # -- shared-prefix membership: EXACT count, seeded permutation -----
+    k = int(round(cfg.shared_prefix_ratio * n))
+    shared_idx = set(int(i) for i in rng.permutation(n)[:k])
+    prefix = rng.integers(0, cfg.vocab_size,
+                          size=(cfg.shared_prefix_len,)).astype(np.int32)
+
+    # -- per-request prompt/generation shapes --------------------------
+    lens, weights = zip(*cfg.prompt_len_mix)
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+    reqs: List[TraceRequest] = []
+    for i in range(n):
+        mode = int(lens[int(rng.choice(len(lens), p=w))])
+        jit = float(rng.uniform(-cfg.prompt_len_jitter,
+                                cfg.prompt_len_jitter))
+        plen = max(1, int(round(mode * (1.0 + jit))))
+        gen = int(cfg.gen_len_min - 1 + rng.zipf(cfg.gen_len_zipf_a))
+        gen = max(cfg.gen_len_min, min(cfg.gen_len_max, gen))
+        if i in shared_idx:
+            # the shared prefix plus a unique suffix; the prompt keeps
+            # at least one unique token so exact-match prefix reuse
+            # still runs the real last token through prefill
+            plen = max(plen, cfg.shared_prefix_len + 1)
+            sfx = rng.integers(
+                0, cfg.vocab_size,
+                size=(plen - cfg.shared_prefix_len,)).astype(np.int32)
+            prompt = np.concatenate([prefix, sfx])
+        else:
+            prompt = rng.integers(0, cfg.vocab_size,
+                                  size=(plen,)).astype(np.int32)
+        if cfg.max_total_len is not None:
+            if len(prompt) >= cfg.max_total_len:
+                prompt = prompt[:cfg.max_total_len - 1]
+            gen = max(1, min(gen, cfg.max_total_len - len(prompt)))
+        reqs.append(TraceRequest(idx=i, arrival_s=arrivals[i],
+                                 prompt=prompt, max_new_tokens=gen,
+                                 shared_prefix=i in shared_idx,
+                                 regime=regimes[i]))
+    return Trace(config=cfg, requests=reqs)
+
+
+# ----------------------------------------------------------------------
+# SLO + goodput
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """p99-style per-request bounds: TTFT (submit → first token) and
+    TPOT (first token → retirement, per output token), milliseconds.
+    A request meets SLO iff it finished AND both bounds hold (TPOT is
+    vacuous for single-token requests)."""
+
+    ttft_ms: float
+    tpot_ms: float
+
+    def to_jsonable(self) -> dict:
+        return {"ttft_ms": round(self.ttft_ms, 3),
+                "tpot_ms": round(self.tpot_ms, 3)}
+
+
+# the ONE nearest-rank percentile convention, shared with serving's
+# latency_stats/statusz (see registry.pct) — re-exported here because
+# the load report is where the convention is most visible
+pct = telemetry_registry.pct
+
+
+def compute_goodput(records: Sequence[dict], slo: SLOConfig,
+                    wall_s: float) -> dict:
+    """Goodput under SLO over completed-request ``records``.
+
+    Each record needs ``n_out`` (output tokens), ``ttft_ms``, and
+    ``tpot_ms`` (None when n_out < 2).  Offered-but-unfinished requests
+    should be passed with ``n_out=0, ttft_ms=inf`` — an unfinished
+    request is an SLO violation, not a statistical no-show."""
+    n = len(records)
+    met_tokens = 0
+    all_tokens = 0
+    met = 0
+    ttfts: List[float] = []
+    tpots: List[float] = []
+    for r in records:
+        n_out = int(r["n_out"])
+        all_tokens += n_out
+        ttft = float(r["ttft_ms"])
+        tpot = r.get("tpot_ms")
+        if ttft == ttft and ttft != float("inf"):
+            ttfts.append(ttft)
+        if tpot is not None and tpot == tpot:
+            tpots.append(float(tpot))
+        ok = n_out > 0 and ttft <= slo.ttft_ms and \
+            (tpot is None or tpot <= slo.tpot_ms)
+        if ok:
+            met += 1
+            met_tokens += n_out
+    ttfts.sort()
+    tpots.sort()
+    wall = max(wall_s, 1e-9)
+    return {
+        "n_requests": n,
+        "slo": slo.to_jsonable(),
+        "slo_attainment": round(met / n, 6) if n else None,
+        "slo_met": met,
+        "goodput_tok_s": round(met_tokens / wall, 3),
+        "goodput_rps": round(met / wall, 4),
+        "total_tok_s": round(all_tokens / wall, 3),
+        # dstpu-lint: disable-next-line=DSTPU006 -- report JSON key (the gate floor's numerator), not a registry metric; the scrapeable aggregate is loadgen_goodput_tokens_rate
+        "goodput_token_ratio":
+            round(met_tokens / all_tokens, 6) if all_tokens else None,
+        "total_output_tokens": all_tokens,
+        "ttft_p50_ms": round(pct(ttfts, 0.50), 3),
+        "ttft_p99_ms": round(pct(ttfts, 0.99), 3),
+        "tpot_p50_ms": round(pct(tpots, 0.50), 3),
+        "tpot_p99_ms": round(pct(tpots, 0.99), 3),
+    }
+
+
+# ----------------------------------------------------------------------
+# lifecycle collection (the per-request waterfall)
+# ----------------------------------------------------------------------
+class LifecycleCollector:
+    """Batcher lifecycle observer (``add_lifecycle_observer``): records
+    every (t, uid, event, extra) so the report can render per-request
+    waterfalls and attribute an SLO violation to a phase."""
+
+    def __init__(self):
+        self.events: Dict[int, List[Tuple[float, str, dict]]] = {}
+
+    def __call__(self, t: float, uid: int, event: str, extra: dict) -> None:
+        self.events.setdefault(uid, []).append((t, event, dict(extra)))
+
+    def first(self, uid: int, event: str) -> Optional[Tuple[float, dict]]:
+        for t, ev, extra in self.events.get(uid, ()):
+            if ev == event:
+                return t, extra
+        return None
+
+    def waterfall(self, uid: int, t0: float) -> dict:
+        """Phase boundaries for one request, seconds relative to ``t0``:
+        queued (submit → prefill start), prefill (→ first token), decode
+        (→ retire), plus prefix-cache hit/miss and decode-vs-verify
+        token counts."""
+        sub = self.first(uid, "submit")
+        pf = self.first(uid, "prefill_start")
+        ft = self.first(uid, "first_token")
+        ret = self.first(uid, "retire")
+        decode_toks = verify_toks = 0
+        for _, ev, extra in self.events.get(uid, ()):
+            if ev == "emit":
+                if extra.get("kind") == "verify":
+                    verify_toks += int(extra.get("n", 0))
+                else:
+                    decode_toks += int(extra.get("n", 0))
+        out: dict = {"uid": uid}
+        for name, rec in (("submit", sub), ("prefill_start", pf),
+                          ("first_token", ft), ("retire", ret)):
+            out[f"t_{name}_s"] = \
+                None if rec is None else round(rec[0] - t0, 6)
+        if pf is not None:
+            out["prefix_hit_tokens"] = int(pf[1].get("hit_tokens", 0))
+            out["prefill_tokens"] = int(pf[1].get("prefill_tokens", 0))
+        if ret is not None:
+            out.update({k: ret[1].get(k) for k in
+                        ("n_out", "ttft_ms", "tpot_ms", "slo_ok")
+                        if k in ret[1]})
+        out["decode_tokens"] = decode_toks
+        out["verify_tokens"] = verify_toks
+        # phase durations (the waterfall bars); None when a boundary is
+        # missing (e.g. the request never finished)
+        def _dur(a, b):
+            if a is None or b is None:
+                return None
+            return round(b[0] - a[0], 6)
+
+        out["queued_s"] = _dur(sub, pf)
+        out["prefill_s"] = _dur(pf, ft)
+        out["decode_s"] = _dur(ft, ret)
+        return out
+
+
+# ----------------------------------------------------------------------
+# replay
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class LoadReport:
+    """One replay's results: aggregate goodput-under-SLO + per-request
+    waterfalls + queue-depth timeline + host-phase attribution."""
+
+    trace_sha256: str
+    trace_config: dict
+    slo: dict
+    wall_s: float
+    goodput: dict
+    waterfalls: List[dict]
+    queue_timeline: List[dict]
+    phases: dict
+    completed: int
+    offered: int
+
+    def to_jsonable(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def table(self) -> str:
+        """Human summary (the CLI's stdout)."""
+        g = self.goodput
+        lines = [
+            f"trace {self.trace_sha256[:12]}…  offered {self.offered} "
+            f"requests, completed {self.completed}, wall "
+            f"{self.wall_s:.2f}s",
+            f"SLO: TTFT <= {g['slo']['ttft_ms']:.1f} ms, TPOT <= "
+            f"{g['slo']['tpot_ms']:.1f} ms/token",
+            f"{'goodput (under SLO)':<24}{g['goodput_tok_s']:>10.1f} tok/s"
+            f"  {g['goodput_rps']:>8.2f} req/s",
+            f"{'throughput (all)':<24}{g['total_tok_s']:>10.1f} tok/s",
+            f"{'SLO attainment':<24}"
+            f"{100.0 * (g['slo_attainment'] or 0.0):>9.1f}%"
+            f"   ({g['slo_met']}/{g['n_requests']})",
+            f"{'goodput token ratio':<24}"
+            # dstpu-lint: disable-next-line=DSTPU006 -- report JSON key read-back, not a registry metric
+            f"{(g['goodput_token_ratio'] or 0.0):>10.3f}",
+            f"{'TTFT p50/p99':<24}{g['ttft_p50_ms']:>10.1f} /"
+            f" {g['ttft_p99_ms']:.1f} ms",
+            f"{'TPOT p50/p99':<24}{g['tpot_p50_ms']:>10.2f} /"
+            f" {g['tpot_p99_ms']:.2f} ms/token",
+        ]
+        ph = {k: v for k, v in self.phases.items() if v}
+        if ph:
+            lines.append("host phases: " + "  ".join(
+                f"{k}={v:.2f}s" for k, v in sorted(ph.items())))
+        if self.queue_timeline:
+            peak = max(s["queued"] for s in self.queue_timeline)
+            lines.append(f"peak queue depth: {peak}")
+        return "\n".join(lines)
+
+    def format_waterfalls(self, limit: int = 8) -> str:
+        """The ``limit`` slowest-TTFT request waterfalls as text bars."""
+        done = [w for w in self.waterfalls if w.get("ttft_ms") is not None]
+        done.sort(key=lambda w: -w["ttft_ms"])
+        lines = [f"{'uid':>5} {'queued':>9} {'prefill':>9} {'decode':>9} "
+                 f"{'ttft_ms':>9} {'tpot_ms':>9} {'tok':>5} {'hit':>5} slo"]
+        for w in done[:limit]:
+            def ms(x):
+                return "-" if x is None else f"{1e3 * x:9.1f}"
+            tpot = w.get("tpot_ms")
+            lines.append(
+                f"{w['uid']:>5} {ms(w['queued_s'])} {ms(w['prefill_s'])} "
+                f"{ms(w['decode_s'])} {w['ttft_ms']:>9.1f} "
+                f"{'-' if tpot is None else format(tpot, '9.2f'):>9} "
+                f"{w.get('n_out', 0):>5} "
+                f"{w.get('prefix_hit_tokens', 0):>5} "
+                f"{'ok' if w.get('slo_ok') else 'VIOL'}")
+        return "\n".join(lines)
+
+
+_last_report: Optional[LoadReport] = None
+
+
+def _loadgen_status() -> Optional[dict]:
+    """``/statusz`` ``loadgen`` section: the last replay's aggregate."""
+    if _last_report is None:
+        return None
+    g = _last_report.goodput
+    return {
+        "trace_sha256": _last_report.trace_sha256,
+        "wall_s": round(_last_report.wall_s, 3),
+        "offered": _last_report.offered,
+        "completed": _last_report.completed,
+        "slo": g["slo"],
+        "slo_attainment": g["slo_attainment"],
+        "goodput_tok_s": g["goodput_tok_s"],
+        "total_tok_s": g["total_tok_s"],
+        "ttft_p99_ms": g["ttft_p99_ms"],
+        "tpot_p99_ms": g["tpot_p99_ms"],
+    }
+
+
+def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
+           ticks: int = 4, time_scale: float = 1.0,
+           on_progress: Optional[Callable[[str], None]] = None
+           ) -> LoadReport:
+    """Replay ``trace`` against ``batcher`` in open loop and report
+    goodput under ``slo``.
+
+    Arrivals are driven by the trace's own clock (scaled by
+    ``time_scale`` — 2.0 replays a trace at twice its recorded offered
+    load): a request is submitted the moment its arrival time passes,
+    whether or not the pool has room — queueing delay is part of what
+    is being measured.  The batcher steps ``ticks`` decode ticks per
+    iteration whenever work is pending and sleeps only when idle before
+    the next arrival.
+
+    ``slo=None`` measures without configuring the batcher's retire-time
+    tagging (warmup replays use this so throwaway requests don't
+    inflate the ``serving_slo_*`` counters); the report then judges
+    against effectively-infinite bounds.  A real ``slo`` is installed
+    via ``set_slo`` for the duration and the previous bounds restored
+    after — a load run must not permanently reconfigure a deployment's
+    batcher."""
+    judge = slo if slo is not None else SLOConfig(ttft_ms=1e12,
+                                                 tpot_ms=1e12)
+    reqs = sorted(trace.requests, key=lambda r: r.arrival_s)
+    collector = LifecycleCollector()
+    remove = batcher.add_lifecycle_observer(collector)
+    prev_slo = (batcher._slo_ttft_ms, batcher._slo_tpot_ms)
+    if slo is not None:
+        batcher.set_slo(slo.ttft_ms, slo.tpot_ms)
+    gp0 = goodput_mod.summary()
+    timeline: List[dict] = []
+    uid_by_idx: Dict[int, int] = {}
+    t0 = time.perf_counter()
+    try:
+        i = 0
+        last_progress = 0
+        n = len(reqs)
+        while i < n or batcher.pending:
+            now_v = (time.perf_counter() - t0) * time_scale
+            while i < n and reqs[i].arrival_s <= now_v:
+                uid = batcher.submit(reqs[i].prompt,
+                                     max_new_tokens=reqs[i].max_new_tokens)
+                uid_by_idx[reqs[i].idx] = uid
+                i += 1
+            # raw deque/slot reads, NOT _telemetry_status(): that sorts
+            # the full latency windows per call, and this loop is inside
+            # the very wall-clock the report measures
+            timeline.append({
+                "t_s": round(now_v / time_scale, 4),
+                "queued": len(batcher._queue) + len(batcher._parked),
+                "active": sum(s is not None for s in batcher._slots)})
+            if batcher.pending:
+                batcher.step(ticks=ticks)
+            elif i < n:
+                time.sleep(min(
+                    max(0.0, (reqs[i].arrival_s - now_v) / time_scale),
+                    0.05))
+            if on_progress is not None and i - last_progress >= 64:
+                last_progress = i
+                on_progress(f"submitted {i}/{n}, pending {batcher.pending}")
+    finally:
+        remove()
+        if slo is not None:
+            batcher.set_slo(*prev_slo)
+    wall = time.perf_counter() - t0
+
+    gp1 = goodput_mod.summary()
+    phases = {k: round(gp1.get(f"{k}_s", 0.0) - gp0.get(f"{k}_s", 0.0), 6)
+              for k in ("compute", "data_wait", "checkpoint", "recompile")}
+    phases["idle"] = round(max(0.0, gp1.get("idle_s", 0.0)
+                               - gp0.get("idle_s", 0.0)), 6)
+
+    waterfalls: List[dict] = []
+    records: List[dict] = []
+    completed = 0
+    for r in reqs:
+        uid = uid_by_idx.get(r.idx)
+        w = collector.waterfall(uid, t0) if uid is not None else {"uid": None}
+        w["idx"] = r.idx
+        w["arrival_s"] = round(r.arrival_s, 6)
+        w["shared_prefix"] = r.shared_prefix
+        # coordinated-omission guard: the submit call can lag the
+        # TRACE arrival (the loop was inside batcher.step when the
+        # arrival time passed), and the batcher stamps TTFT at submit —
+        # judging submit-relative TTFT would hide exactly the
+        # regressions (longer tick windows) this harness exists to
+        # catch.  Re-anchor TTFT on the scaled trace arrival.
+        arr_rel = r.arrival_s / time_scale
+        if w.get("t_submit_s") is not None:
+            w["submit_lag_ms"] = round(
+                1e3 * max(0.0, w["t_submit_s"] - arr_rel), 3)
+        waterfalls.append(w)
+        if w.get("t_retire_s") is not None:
+            completed += 1
+            ttft = w.get("ttft_ms", float("inf"))
+            if w.get("t_first_token_s") is not None:
+                w["ttft_submit_ms"] = ttft
+                ttft = round(
+                    1e3 * (w["t_first_token_s"] - arr_rel), 3)
+                w["ttft_ms"] = ttft
+            tpot = w.get("tpot_ms")
+            # the displayed verdict must match the goodput judgment
+            # (the batcher's retire tag is submit-relative)
+            w["slo_ok"] = bool(
+                w.get("n_out", 0) > 0 and ttft == ttft
+                and ttft <= judge.ttft_ms
+                and (tpot is None or tpot <= judge.tpot_ms))
+            records.append({"n_out": w.get("n_out", 0),
+                            "ttft_ms": ttft,
+                            "tpot_ms": tpot})
+        else:
+            # offered but unfinished = a violation, not a no-show
+            records.append({"n_out": 0, "ttft_ms": float("inf"),
+                            "tpot_ms": None})
+    g = compute_goodput(records, judge, wall)
+
+    report = LoadReport(
+        trace_sha256=trace.sha256(),
+        trace_config=dataclasses.asdict(trace.config),
+        slo=judge.to_jsonable(), wall_s=round(wall, 4), goodput=g,
+        waterfalls=waterfalls, queue_timeline=timeline, phases=phases,
+        completed=completed, offered=len(reqs))
+
+    # registry + /statusz surfaces (scrapers see load runs without
+    # reading the report file)
+    telemetry_registry.counter(
+        "loadgen_requests_replayed_total",
+        "requests submitted by trace replays").inc(len(reqs))
+    if g["slo_attainment"] is not None:
+        telemetry_registry.gauge(
+            "loadgen_slo_attainment_ratio",
+            "last replay: fraction of requests meeting SLO"
+        ).set(g["slo_attainment"])
+    telemetry_registry.gauge(
+        "loadgen_goodput_tokens_rate",
+        "last replay: output tokens/s from requests meeting SLO"
+    ).set(g["goodput_tok_s"])
+    telemetry_registry.gauge(
+        "loadgen_offered_tokens_rate",
+        "last replay: output tokens/s across all completed requests"
+    ).set(g["total_tok_s"])
+    global _last_report
+    _last_report = report
+    from . import exporter as telemetry_exporter
+
+    telemetry_exporter.register_status_provider("loadgen", _loadgen_status)
+    return report
+
+
+# ----------------------------------------------------------------------
+# SLO calibration
+# ----------------------------------------------------------------------
+def calibrate_slo(batcher, *, prompt_len: int = 16, max_new: int = 8,
+                  runs: int = 3, ttft_scale: float = 8.0,
+                  tpot_scale: float = 6.0, seed: int = 0) -> SLOConfig:
+    """Machine-relative SLO bounds: measure the box's own UNLOADED
+    TTFT/TPOT with sequential single requests (call after warmup — a
+    compile inside the calibration run would inflate the bounds), take
+    the per-run minimum, and scale.  Absolute bounds don't transfer
+    between a TPU and a CI runner; "k× the hardware's own floor" does —
+    a scheduling regression shows up on either."""
+    rng = np.random.default_rng(seed)
+    collector = LifecycleCollector()
+    remove = batcher.add_lifecycle_observer(collector)
+    ttfts: List[float] = []
+    tpots: List[float] = []
+    try:
+        for _ in range(max(1, runs)):
+            prompt = rng.integers(0, batcher._vocab,
+                                  size=(prompt_len,)).astype(np.int32)
+            uid = batcher.submit(prompt, max_new_tokens=max_new)
+            while uid not in batcher._finished:
+                batcher.step(ticks=4)
+            ret = collector.first(uid, "retire")
+            if ret is None:
+                continue
+            ttft = ret[1].get("ttft_ms")
+            tpot = ret[1].get("tpot_ms")
+            if ttft is not None and ttft == ttft:
+                ttfts.append(float(ttft))
+            if tpot is not None and tpot == tpot:
+                tpots.append(float(tpot))
+    finally:
+        remove()
+    if not ttfts or not tpots:
+        raise RuntimeError("calibration produced no complete requests")
+    return SLOConfig(ttft_ms=max(1.0, min(ttfts) * ttft_scale),
+                     tpot_ms=max(0.1, min(tpots) * tpot_scale))
+
+
+# ----------------------------------------------------------------------
+# regression gate
+# ----------------------------------------------------------------------
+def check_baseline(report: dict, baseline: dict,
+                   tolerance: Optional[float] = None
+                   ) -> Tuple[bool, List[str]]:
+    """Gate a replay ``report`` (``LoadReport.to_jsonable()``) against a
+    checked-in ``baseline`` (``SERVE_LOAD_BASELINE.json``).
+
+    Hard (exact) checks — failures here mean the *trace or decode
+    determinism drifted*, which voids any perf comparison:
+    - ``trace_sha256`` must match,
+    - ``total_output_tokens`` must match (no EOS in random-token traces
+      ⇒ every request runs to its max_new_tokens, so the count is
+      machine-independent).
+
+    Soft (tolerance) checks — the perf gate proper; bounds are
+    machine-relative because the SLO is calibrated per box:
+    - ``slo_attainment`` >= baseline ``slo_attainment_min`` − tolerance,
+    - ``goodput_token_ratio`` >= ``goodput_token_ratio_min`` − tolerance.
+    """
+    tol = float(baseline.get("tolerance", 0.15)
+                if tolerance is None else tolerance)
+    msgs: List[str] = []
+    ok = True
+    want_sha = baseline.get("trace_sha256")
+    if want_sha and report.get("trace_sha256") != want_sha:
+        ok = False
+        msgs.append(
+            f"trace drift: sha256 {report.get('trace_sha256')} != "
+            f"baseline {want_sha} (generator or config changed — "
+            f"re-record the baseline deliberately)")
+    g = report.get("goodput", {})
+    want_tokens = baseline.get("total_output_tokens")
+    if want_tokens is not None and \
+            g.get("total_output_tokens") != want_tokens:
+        ok = False
+        msgs.append(
+            f"determinism drift: total_output_tokens "
+            f"{g.get('total_output_tokens')} != baseline {want_tokens} "
+            f"(requests lost or generation lengths changed)")
+    for key, base_key in (("slo_attainment", "slo_attainment_min"),
+                          # dstpu-lint: disable-next-line=DSTPU006 -- report/baseline JSON keys, not registry metrics
+                          ("goodput_token_ratio",
+                           "goodput_token_ratio_min")):
+        floor = baseline.get(base_key)
+        got = g.get(key)
+        if floor is None:
+            continue
+        if got is None or got < float(floor) - tol:
+            ok = False
+            msgs.append(
+                f"goodput regression: {key}={got} < baseline "
+                f"{base_key}={floor} - tolerance {tol}")
+        else:
+            msgs.append(f"{key}={got} vs floor {floor} (tol {tol}): ok")
+    return ok, msgs

@@ -36,10 +36,21 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "get_registry", "counter", "gauge", "histogram",
     "maybe_install_exit_dump", "flush_exit_dump", "register_collector",
-    "run_collectors", "METRICS_DIR_ENV",
+    "run_collectors", "METRICS_DIR_ENV", "pct",
 ]
 
 METRICS_DIR_ENV = "DSTPU_METRICS_DIR"
+
+
+def pct(sorted_xs, q: float) -> float:
+    """THE repo-wide percentile convention — nearest-rank over an
+    ascending sequence, NaN on empty.  ``ContinuousBatcher``
+    (``latency_stats``/``/statusz``) and ``telemetry/loadgen.py`` both
+    import this one function, so the serving surfaces and the load
+    report cannot disagree on a tail."""
+    if not sorted_xs:
+        return float("nan")
+    return sorted_xs[min(len(sorted_xs) - 1, int(q * len(sorted_xs)))]
 
 # Prometheus default buckets skew web-request-sized; these cover both
 # decode ticks (sub-ms) and train steps / checkpoint writes (minutes).

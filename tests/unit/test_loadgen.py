@@ -1,0 +1,277 @@
+"""Host-side units for the traffic-trace load harness
+(telemetry/loadgen.py): arrival-process determinism and statistics, the
+exact shared-prefix contract, the hand-computed SLO-goodput fixture, the
+regression gate, and flight-recorder request attribution.
+
+Replay against a real ContinuousBatcher lives in ``test_zloadgen.py``
+(the z-sorted convention keeps batcher compiles late in the tier-1
+alphabetical window)."""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import loadgen
+
+
+def _cfg(**kw):
+    base = dict(seed=7, n_requests=64, rate_rps=10.0, vocab_size=128)
+    base.update(kw)
+    return loadgen.TraceConfig(**base)
+
+
+# -- trace determinism ------------------------------------------------------
+
+def test_same_seed_byte_identical_trace():
+    a = loadgen.generate_trace(_cfg())
+    b = loadgen.generate_trace(_cfg())
+    assert a.to_json() == b.to_json()
+    assert a.sha256() == b.sha256()
+
+
+def test_different_seed_different_trace():
+    a = loadgen.generate_trace(_cfg(seed=1))
+    b = loadgen.generate_trace(_cfg(seed=2))
+    assert a.sha256() != b.sha256()
+
+
+def test_every_config_field_is_trace_identity():
+    base = loadgen.generate_trace(_cfg()).sha256()
+    assert loadgen.generate_trace(_cfg(rate_rps=11.0)).sha256() != base
+    assert loadgen.generate_trace(
+        _cfg(shared_prefix_ratio=0.5)).sha256() != base
+
+
+def test_trace_json_roundtrips_config():
+    cfg = _cfg(arrival="bursty", shared_prefix_ratio=0.25)
+    d = json.loads(json.dumps(dataclasses.asdict(cfg)))
+    assert loadgen.trace_config_from_dict(d) == cfg
+
+
+def test_trace_config_unknown_field_rejected():
+    with pytest.raises(ValueError, match="unknown TraceConfig"):
+        loadgen.trace_config_from_dict({"seed": 0, "bogus": 1})
+
+
+def test_invalid_arrival_process_rejected():
+    with pytest.raises(ValueError, match="unknown arrival"):
+        loadgen.generate_trace(_cfg(arrival="uniform"))
+
+
+# -- arrival processes ------------------------------------------------------
+
+def test_poisson_interarrival_mean_within_tolerance():
+    rate = 20.0
+    tr = loadgen.generate_trace(_cfg(n_requests=2000, rate_rps=rate))
+    arr = np.asarray([r.arrival_s for r in tr.requests])
+    gaps = np.diff(np.concatenate([[0.0], arr]))
+    assert gaps.min() > 0          # arrivals strictly increase
+    # 2000 exponential draws: the sample mean lands within ~10% of 1/rate
+    assert abs(gaps.mean() - 1.0 / rate) < 0.1 / rate
+
+
+def test_bursty_produces_distinct_regimes():
+    tr = loadgen.generate_trace(_cfg(
+        n_requests=2000, arrival="bursty", rate_rps=5.0,
+        burst_rate_rps=50.0, burst_enter_p=0.2, burst_exit_p=0.2))
+    gaps = np.diff(np.concatenate(
+        [[0.0], [r.arrival_s for r in tr.requests]]))
+    regimes = [r.regime for r in tr.requests]
+    assert set(regimes) == {"calm", "burst"}
+    calm = np.asarray([g for g, s in zip(gaps, regimes) if s == "calm"])
+    burst = np.asarray([g for g, s in zip(gaps, regimes) if s == "burst"])
+    # the burst regime really is a different (faster) arrival process
+    assert burst.mean() < calm.mean() / 3.0
+
+
+def test_poisson_mode_never_enters_burst():
+    tr = loadgen.generate_trace(_cfg(n_requests=500, burst_enter_p=0.9))
+    assert all(r.regime == "calm" for r in tr.requests)
+
+
+# -- prompt / generation shapes --------------------------------------------
+
+def test_shared_prefix_ratio_honored_exactly():
+    for n, ratio in ((32, 0.25), (24, 0.33), (10, 1.0), (16, 0.0)):
+        tr = loadgen.generate_trace(_cfg(
+            n_requests=n, shared_prefix_ratio=ratio, shared_prefix_len=6))
+        members = [r for r in tr.requests if r.shared_prefix]
+        assert len(members) == round(ratio * n)
+        if members:
+            prefix = members[0].prompt[:6]
+            for r in members:
+                np.testing.assert_array_equal(r.prompt[:6], prefix)
+                # at least one unique token beyond the shared prefix, so
+                # exact-match prefix reuse still prefilling the real last
+                # token (the kvreuse one-short cap) is exercised
+                assert len(r.prompt) >= 7
+
+
+def test_gen_lengths_clamped_and_long_tailed():
+    tr = loadgen.generate_trace(_cfg(
+        n_requests=2000, gen_len_min=2, gen_len_max=64))
+    lens = np.asarray([r.max_new_tokens for r in tr.requests])
+    assert lens.min() >= 2 and lens.max() <= 64
+    # Zipf: the mass sits at the minimum, but a real tail exists
+    assert np.median(lens) <= 4
+    assert lens.max() >= 16
+
+
+def test_max_total_len_too_small_for_shared_prefix_rejected():
+    # truncating to max_total_len would strip the guaranteed unique
+    # suffix token from shared-prefix prompts (degenerate identical
+    # prompts) — the generator must reject, not silently emit them
+    with pytest.raises(ValueError, match="unique suffix"):
+        loadgen.generate_trace(_cfg(
+            shared_prefix_ratio=0.5, shared_prefix_len=8,
+            max_total_len=9))
+    # exactly prefix + suffix token + 1 generated token is fine
+    loadgen.generate_trace(_cfg(
+        shared_prefix_ratio=0.5, shared_prefix_len=8, max_total_len=10))
+
+
+def test_max_total_len_clamps_prompt_plus_gen():
+    tr = loadgen.generate_trace(_cfg(
+        n_requests=200, max_total_len=32,
+        prompt_len_mix=((24, 0.5), (40, 0.5)), gen_len_max=64))
+    for r in tr.requests:
+        assert len(r.prompt) + r.max_new_tokens <= 32
+        assert r.max_new_tokens >= 1 and len(r.prompt) >= 1
+
+
+def test_prompt_tokens_within_vocab():
+    tr = loadgen.generate_trace(_cfg(vocab_size=50))
+    for r in tr.requests:
+        assert r.prompt.dtype == np.int32
+        assert r.prompt.min() >= 0 and r.prompt.max() < 50
+
+
+# -- SLO goodput (hand-computed fixture) ------------------------------------
+
+def test_goodput_matches_hand_computed_fixture():
+    slo = loadgen.SLOConfig(ttft_ms=100.0, tpot_ms=10.0)
+    records = [
+        # meets both bounds → 10 good tokens
+        {"n_out": 10, "ttft_ms": 50.0, "tpot_ms": 5.0},
+        # straddles the TTFT bound (150 > 100) → violation
+        {"n_out": 20, "ttft_ms": 150.0, "tpot_ms": 5.0},
+        # TTFT fine, TPOT blown (12 > 10) → violation
+        {"n_out": 2, "ttft_ms": 90.0, "tpot_ms": 12.0},
+        # single-token request: TPOT vacuous → meets on TTFT alone
+        {"n_out": 1, "ttft_ms": 99.0, "tpot_ms": None},
+        # offered but never finished → violation, not a no-show
+        {"n_out": 0, "ttft_ms": float("inf"), "tpot_ms": None},
+    ]
+    g = loadgen.compute_goodput(records, slo, wall_s=2.0)
+    assert g["n_requests"] == 5
+    assert g["slo_met"] == 2
+    assert g["slo_attainment"] == pytest.approx(2 / 5)
+    assert g["goodput_tok_s"] == pytest.approx((10 + 1) / 2.0)
+    assert g["goodput_rps"] == pytest.approx(2 / 2.0)
+    assert g["total_tok_s"] == pytest.approx(33 / 2.0)
+    assert g["goodput_token_ratio"] == pytest.approx(11 / 33, abs=1e-6)
+    assert g["total_output_tokens"] == 33
+    # nearest-rank over sorted finite TTFTs [50, 90, 99, 150]
+    assert g["ttft_p50_ms"] == 99.0
+    assert g["ttft_p99_ms"] == 150.0
+    # sorted TPOTs [5, 5, 12]
+    assert g["tpot_p50_ms"] == 5.0
+    assert g["tpot_p99_ms"] == 12.0
+
+
+def test_goodput_boundary_value_meets_slo():
+    slo = loadgen.SLOConfig(ttft_ms=100.0, tpot_ms=10.0)
+    g = loadgen.compute_goodput(
+        [{"n_out": 3, "ttft_ms": 100.0, "tpot_ms": 10.0}], slo, 1.0)
+    assert g["slo_met"] == 1        # bounds are inclusive
+
+
+def test_pct_convention_matches_serving():
+    from deepspeed_tpu.inference.serving import _pct
+
+    for xs in ([], [3.0], [1.0, 2.0, 3.0, 4.0], list(range(100))):
+        for q in (0.5, 0.9, 0.99):
+            a, b = loadgen.pct(xs, q), _pct(xs, q)
+            assert (a != a and b != b) or a == b
+
+
+# -- regression gate --------------------------------------------------------
+
+def _report(sha="abc", attain=0.9, ratio=0.9, tokens=100):
+    return {"trace_sha256": sha,
+            "goodput": {"slo_attainment": attain,
+                        "goodput_token_ratio": ratio,
+                        "total_output_tokens": tokens}}
+
+
+def _baseline(sha="abc", attain_min=0.8, ratio_min=0.8, tokens=100):
+    return {"trace_sha256": sha, "total_output_tokens": tokens,
+            "slo_attainment_min": attain_min,
+            "goodput_token_ratio_min": ratio_min, "tolerance": 0.05}
+
+
+def test_gate_passes_at_baseline():
+    ok, msgs = loadgen.check_baseline(_report(), _baseline())
+    assert ok and any("ok" in m for m in msgs)
+
+
+def test_gate_fails_on_goodput_regression_beyond_tolerance():
+    ok, msgs = loadgen.check_baseline(_report(attain=0.70), _baseline())
+    assert not ok
+    assert any("goodput regression" in m and "slo_attainment" in m
+               for m in msgs)
+    # within tolerance (0.8 - 0.05 = 0.75 floor) still passes
+    ok, _ = loadgen.check_baseline(_report(attain=0.76), _baseline())
+    assert ok
+
+
+def test_gate_fails_on_trace_drift():
+    ok, msgs = loadgen.check_baseline(_report(sha="xyz"), _baseline())
+    assert not ok and any("trace drift" in m for m in msgs)
+
+
+def test_gate_fails_on_determinism_drift():
+    ok, msgs = loadgen.check_baseline(_report(tokens=99), _baseline())
+    assert not ok and any("determinism drift" in m for m in msgs)
+
+
+def test_gate_tolerance_override():
+    ok, _ = loadgen.check_baseline(_report(attain=0.70), _baseline(),
+                                   tolerance=0.2)
+    assert ok
+
+
+# -- flight-recorder request attribution ------------------------------------
+
+def test_flightrec_mark_carries_context(tmp_path):
+    from deepspeed_tpu.telemetry import flightrec, registry
+
+    registry.counter("loadgen_test_ctx_total", "test").inc(3)
+    rec = flightrec.FlightRecorder(str(tmp_path))
+    rec.mark("serving", context={"uids": [4, 7]})
+    entries = [d for d in rec.deltas if d.get("ctx")]
+    assert entries and entries[-1]["ctx"] == {"uids": [4, 7]}
+
+
+def test_flightrec_pretty_names_in_flight_uids():
+    from deepspeed_tpu.telemetry import flightrec
+
+    payload = {
+        "reason": "sigterm", "time_unix": 100.0, "rank": 0, "pid": 1,
+        "uptime_s": 5.0, "goodput": {},
+        "spans": [{"t": 99.0, "name": "serve/decode-tick", "dur_ms": 2.0,
+                   "args": {"uids": [11, 12]}}],
+        "logs": [],
+        "metric_deltas": [{"t": 99.5, "label": "serving",
+                           "deltas": {"serving_decode_ticks_total": 4},
+                           "ctx": {"uids": [11, 12, 13]}}],
+        "metrics": [],
+    }
+    out = flightrec.pretty(payload)
+    # the delta context wins (it is the most recent serving mark)
+    assert "in-flight request uids at last mark: [11, 12, 13]" in out
+    # span-args fallback when no delta carries context
+    del payload["metric_deltas"][0]["ctx"]
+    out = flightrec.pretty(payload)
+    assert "in-flight request uids at last mark: [11, 12]" in out

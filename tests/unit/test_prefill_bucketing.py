@@ -37,9 +37,9 @@ def _spy_prefills(batcher):
     calls = []
     orig = batcher._prefill
 
-    def spy(ids, cache=None, start=0):
+    def spy(ids, cache=None, start=0, **kw):
         calls.append((int(ids.shape[0]), int(ids.shape[1]), int(start)))
-        return orig(ids, cache=cache, start=start)
+        return orig(ids, cache=cache, start=start, **kw)
 
     batcher._prefill = spy
     return calls
