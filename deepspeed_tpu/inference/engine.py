@@ -71,6 +71,11 @@ class InferenceConfig:
     # ContinuousBatcher at construction — plain generate() calls are
     # unaffected.
     specdec: Any = None
+    # page-resident serving (paged decode attention over the prefix
+    # cache's arena, ops/pallas/paged_attention.py): None = ON whenever
+    # prefix_cache resolves; False opts out back to the gather path.
+    # DSTPU_PAGED_DECODE env-overrides.  Consumed by ContinuousBatcher.
+    paged_decode: Any = None
 
     @staticmethod
     def load(d) -> "InferenceConfig":
@@ -349,18 +354,32 @@ class InferenceEngine:
     __call__ = forward
 
     # ------------------------------------------------------------------
+    def _prefill_impl(self, params, cache, input_ids, position_ids):
+        """The ONE prefill body — jitted twice below (with and without
+        cache donation) so the two paths can never diverge."""
+        out, vars_ = self._decode_model.apply(
+            {"params": params, "cache": cache}, input_ids,
+            position_ids=position_ids, mutable=["cache"])
+        return out["logits"], vars_["cache"]
+
     @functools.cached_property
     def _compiled_prefill(self):
-        def prefill(params, cache, input_ids, position_ids):
-            out, vars_ = self._decode_model.apply(
-                {"params": params, "cache": cache}, input_ids,
-                position_ids=position_ids, mutable=["cache"])
-            return out["logits"], vars_["cache"]
-
         # chunked prefill compiles one executable per pow2 chunk length
         # and batch width BY DESIGN — counted, never warned
-        return recompile.watch(jax.jit(prefill), name="inference.prefill",
-                               warn=False)
+        return recompile.watch(jax.jit(self._prefill_impl),
+                               name="inference.prefill", warn=False)
+
+    @functools.cached_property
+    def _compiled_prefill_donated(self):
+        """Prefill with the CACHE DONATED — the page-resident serving
+        path: its cache tree carries the shared page arena, and without
+        donation every suffix-prefill chunk would copy the whole arena
+        to apply an O(chunk) append.  Callers must rebind the arena from
+        the returned cache (``PagedServingState.adopt``) — the donated
+        input buffers are dead after the call."""
+        return recompile.watch(
+            jax.jit(self._prefill_impl, donate_argnums=(1,)),
+            name="inference.prefill_paged", warn=False)
 
     @functools.lru_cache(maxsize=16)
     def _compiled_decode_step(self, top_k: int, top_p: float,

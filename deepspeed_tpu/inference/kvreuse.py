@@ -51,10 +51,15 @@ from ..telemetry import (memory as telemetry_memory, recompile,
                          registry as telemetry_registry)
 from ..utils.logging import logger
 
-__all__ = ["PagedKVPool", "RadixPrefixCache", "resolve_prefix_cache",
-           "PREFIX_CACHE_ENV"]
+__all__ = ["PagedKVPool", "RadixPrefixCache", "PagedServingState",
+           "resolve_prefix_cache", "resolve_paged_decode",
+           "PREFIX_CACHE_ENV", "PAGED_DECODE_ENV"]
 
 PREFIX_CACHE_ENV = "DSTPU_PREFIX_CACHE"
+# page-resident serving (paged decode attention): default ON whenever a
+# prefix cache is resolved; =0 is the operator kill switch back to the
+# gather-then-contiguous admission path
+PAGED_DECODE_ENV = "DSTPU_PAGED_DECODE"
 
 _DEFAULT_PAGE_TOKENS = 16
 _DEFAULT_BUDGET_BYTES = 64 << 20
@@ -159,6 +164,13 @@ class PagedKVPool:
         # LRU free list: free() appends, alloc() pops the oldest-freed
         self._free: List[int] = list(range(self.n_pages))
         self._op_memo: Dict[tuple, object] = {}
+        # the copy-tax witness: page-resident serving must keep this at
+        # ZERO on the steady-state path (asserted by the paged e2e test
+        # and reported by the bench paged-vs-gather block)
+        self._m_gather = telemetry_registry.counter(
+            "serving_gather_pages_total",
+            "admission-time page materializations (arena pages copied "
+            "into a contiguous admission cache; 0 under paged decode)")
 
     # -- host-side page accounting -------------------------------------
     @property
@@ -242,6 +254,7 @@ class PagedKVPool:
         pt = self.page_tokens
         offs = [i * pt for i in range(len(pids))]
         pid_arr, off_arr = self._pad(list(pids), offs)
+        self._m_gather.inc()
         return self._gather_fn(int(pid_arr.shape[0]))(
             self.pages, cache, pid_arr, off_arr, n_tokens)
 
@@ -497,6 +510,54 @@ class RadixPrefixCache:
         self._m_in_use.set(float(self.pool.pages_in_use))
         return len(pids)
 
+    def absorb(self, prompt, own_pages, first_own: int) -> set:
+        """ZERO-COPY donation — the page-resident retirement path: a
+        retiring slot's full-prompt pages attach to the tree BY
+        REFERENCE (ownership transfers; nothing moves on device).  The
+        slot's blocks ``[0, first_own)`` are the tree's own matched
+        chain (still pinned by the caller at this point), so attachment
+        starts at the deepest existing match and block ``d`` takes
+        ``own_pages[d - first_own]``.  Returns the page ids the tree
+        took; the caller frees the rest.  Correctness rests on the paged
+        write discipline: a slot's prompt-prefix pages are written once
+        by its suffix prefill and never touched again (decode appends at
+        positions >= prompt_len, overshoot resolves to trash entries),
+        so the absorbed pages hold exactly the K/V a fresh prefill of
+        those blocks would produce."""
+        pt = self.page_tokens
+        n_target = len(prompt) // pt
+        if n_target <= first_own:
+            return set()     # prompt region fully covered by hit pages
+        keys = self._blocks(prompt, n_target)
+        self._clock += 1
+        node, depth, walked = self._root, 0, []
+        while depth < n_target and keys[depth] in node.children:
+            node = node.children[keys[depth]]
+            node.last_used = self._clock
+            walked.append(node)
+            depth += 1
+        if depth == n_target or depth < first_own:
+            # fully cached already (a sibling retired the same prefix
+            # first), or the walk ended inside the pinned hit chain
+            # (impossible while pinned — defensive: attaching here would
+            # alias tree-owned pages)
+            if walked:
+                self._push_candidate(walked[-1])
+            return set()
+        absorbed = set()
+        for d in range(depth, n_target):
+            pid = own_pages[d - first_own]
+            child = _Node(keys[d], pid, node)
+            child.last_used = self._clock
+            node.children[keys[d]] = child
+            self._nodes.add(child)
+            node = child
+            absorbed.add(pid)
+        self._push_candidate(node)   # the new chain's tip is a leaf
+        self._m_donated.inc(len(absorbed))
+        self._m_in_use.set(float(self.pool.pages_in_use))
+        return absorbed
+
     # ------------------------------------------------------------------
     def _telemetry_status(self) -> dict:
         return {
@@ -558,3 +619,318 @@ def resolve_prefix_cache(engine, override=None) -> Optional[RadixPrefixCache]:
                              budget // max(1, _page_bytes(meta))))
     pool = PagedKVPool(engine, int(n_pages), page_tokens, meta=meta)
     return RadixPrefixCache(pool)
+
+
+# ---------------------------------------------------------------------------
+# Page-resident serving (paged decode attention)
+# ---------------------------------------------------------------------------
+#
+# With the paged attention kernel (ops/pallas/paged_attention.py) the
+# batcher no longer materializes a contiguous per-slot cache at all: the
+# slot's K/V lives in the POOL ARENA for its whole life.  Admission
+# becomes page-ref bookkeeping (hit pages are referenced, not copied; the
+# suffix prefill writes straight into freshly allocated pages), decode
+# attention reads the arena through a per-slot page table, and retirement
+# donates the prompt's pages to the radix tree BY REFERENCE.  The two
+# O(history) device copies of the gather path — gather_pages at admission,
+# donate_pages at retirement — both disappear.
+
+
+@dataclasses.dataclass
+class _SlotPages:
+    """Page ownership of one page-resident request (parked or slotted)."""
+
+    own: list            # pages allocated for the suffix + generation span
+    nodes: tuple         # pinned radix nodes backing the hit prefix
+    m0: int              # matched prefix tokens (page-aligned)
+    prompt_len: int
+    table_row: np.ndarray    # (T,) int32, trash-padded past the span
+
+
+class PagedServingState:
+    """Host-side page bookkeeping + paged-cache-tree plumbing for a
+    :class:`~.serving.ContinuousBatcher` running page-resident slots.
+
+    Owns: the reserved trash page (overshoot writes resolve there — a
+    retired or bucket-padded row's head past its allocation must never
+    touch another slot's pages), the live ``(n_slots, T)`` page table and
+    per-slot lengths the decode windows are built from, and the per-slot
+    :class:`_SlotPages` metadata.  The POOL becomes this batcher's
+    property in paged mode: every jitted window donates the arena buffers
+    and :meth:`adopt` rebinds them, so a second batcher sharing the pool
+    would read freed buffers.
+    """
+
+    def __init__(self, cache: RadixPrefixCache, engine, n_slots: int):
+        self.cache = cache
+        self.pool = cache.pool
+        self.pt = self.pool.page_tokens
+        self.gen_limit = int(engine._gen_limit)
+        self.T = -(-self.gen_limit // self.pt)
+        self.n_slots = int(n_slots)
+        need = self.n_slots * self.T + 1
+        if self.pool.n_pages < need:
+            raise ValueError(
+                f"pool holds {self.pool.n_pages} pages but page-resident "
+                f"slots need n_slots*ceil(gen_limit/page_tokens)+1 = "
+                f"{self.n_slots}*{self.T}+1 = {need} worst-case; raise "
+                f"n_pages/budget_bytes or lower max_tokens")
+        trash = cache._alloc(1)
+        if trash is None:
+            raise ValueError("could not reserve the overshoot trash page")
+        self.trash = int(trash[0])
+        self.table = np.full((self.n_slots, self.T), self.trash, np.int32)
+        self.lengths = np.zeros((self.n_slots,), np.int32)
+        self.slot_meta = [None] * self.n_slots
+        self._tpl_memo: Dict[int, list] = {}
+        self._slot_pages_n = 0
+        self._bytes_per_token = max(1, self.pool.page_bytes // self.pt)
+        # per-INSTANCE tallies for /statusz: registry counters are
+        # process-wide (a second batcher would report the first's
+        # totals — the specdec statusz convention)
+        self._admissions = 0
+        self._copy_bytes_saved = 0
+        self._ref_donated = 0
+        self._m_admit = telemetry_registry.counter(
+            "paged_attn_admissions_total",
+            "requests admitted page-resident (no gather, no contiguous "
+            "admission cache)")
+        self._m_saved = telemetry_registry.counter(
+            "paged_attn_copy_bytes_saved_total",
+            "device copy bytes eliminated vs the gather path (admission "
+            "gathers + retirement donates that became page-ref moves)")
+        self._m_ref_donated = telemetry_registry.counter(
+            "paged_attn_ref_donated_pages_total",
+            "pages donated to the radix tree by reference (zero-copy)")
+        self._m_slot_pages = telemetry_registry.gauge(
+            "paged_attn_slot_pages",
+            "arena pages owned by parked/active page-resident requests")
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_owner(
+            "paged_decode", self, "_telemetry_status")
+
+    # -- admission / placement / retirement ----------------------------
+    def try_admit(self, prompt, max_new: int, m0: int, nodes, pids,
+                  span_tokens: int) -> Optional[_SlotPages]:
+        """Allocate the request's own pages covering
+        ``[m0, span_tokens)`` and build its table row; None when the
+        budget (after eviction) cannot supply them — the caller applies
+        backpressure.  ``span_tokens`` covers both the bucket-padded
+        prefill writes and the generation span, so the table never has
+        to change mid-flight."""
+        first_own = m0 // self.pt
+        n_own = -(-span_tokens // self.pt) - first_own
+        # pin BEFORE _alloc, for the request's LIFETIME: _alloc's
+        # eviction sweep could otherwise recycle the matched chain this
+        # very admission is about to read every tick
+        self.cache.pin(nodes)
+        own = self.cache._alloc(n_own) if n_own > 0 else []
+        if own is None:
+            self.cache.unpin(nodes)
+            return None
+        row = np.full((self.T,), self.trash, np.int32)
+        row[:first_own] = pids
+        row[first_own:first_own + len(own)] = own
+        meta = _SlotPages(own=list(own), nodes=tuple(nodes), m0=int(m0),
+                          prompt_len=int(len(prompt)), table_row=row)
+        self._m_admit.inc()
+        self._admissions += 1
+        # the gather path would copy the m0 hit tokens into a fresh cache
+        self._m_saved.inc(int(m0) * self._bytes_per_token)
+        self._copy_bytes_saved += int(m0) * self._bytes_per_token
+        self._slot_pages_n += len(own)
+        self._m_slot_pages.set(float(self._slot_pages_n))
+        return meta
+
+    def place(self, i: int, meta: _SlotPages) -> None:
+        self.slot_meta[i] = meta
+        self.table[i, :] = meta.table_row
+        self.lengths[i] = meta.prompt_len
+
+    def retire_slot(self, i: int, prompt) -> None:
+        meta = self.slot_meta[i]
+        self.slot_meta[i] = None
+        self.table[i, :] = self.trash
+        self.lengths[i] = 0
+        if meta is not None:
+            self._release(meta, prompt)
+
+    def finish_unslotted(self, meta: _SlotPages, prompt) -> None:
+        """A request retired by its first token releases its pages
+        without ever holding a slot (prompt pages still donate)."""
+        self._release(meta, prompt)
+
+    def abort_admit(self, meta: _SlotPages) -> None:
+        """Roll back a ``try_admit`` whose prefill never completed: free
+        the own pages and unpin the hit chain WITHOUT absorbing — the
+        pages hold no (or partial) K/V, so attaching them to the tree
+        would serve garbage to the next hit."""
+        self.cache.unpin(meta.nodes)
+        if meta.own:
+            self.pool.free(meta.own)
+            self.cache._m_in_use.set(float(self.pool.pages_in_use))
+        self._slot_pages_n -= len(meta.own)
+        self._m_slot_pages.set(float(self._slot_pages_n))
+
+    def _release(self, meta: _SlotPages, prompt) -> None:
+        absorbed = self.cache.absorb(prompt, meta.own,
+                                     meta.m0 // self.pt)
+        self.cache.unpin(meta.nodes)
+        leftover = [p for p in meta.own if p not in absorbed]
+        if leftover:
+            self.pool.free(leftover)
+            self.cache._m_in_use.set(float(self.pool.pages_in_use))
+        if absorbed:
+            self._m_ref_donated.inc(len(absorbed))
+            self._ref_donated += len(absorbed)
+            # the gather path's donate_pages would have COPIED these
+            self._m_saved.inc(len(absorbed) * self.pt
+                              * self._bytes_per_token)
+            self._copy_bytes_saved += len(absorbed) * self.pt \
+                * self._bytes_per_token
+        self._slot_pages_n -= len(meta.own)
+        self._m_slot_pages.set(float(self._slot_pages_n))
+
+    def note_window(self, ticks: int) -> None:
+        """Mirror the decode window's on-device head advance: EVERY row
+        (free slots included — their writes resolve to trash) appends
+        one token per tick."""
+        self.lengths += int(ticks)
+
+    # -- paged cache trees ---------------------------------------------
+    def _template(self, B: int) -> list:
+        """Per-batch-width cache-tree recipe: (dict-key path, kind,
+        keystr, contiguous leaf shape) per leaf of the model's abstract
+        cache — eval_shape runs once per width, not per window."""
+        if B not in self._tpl_memo:
+            tpl = jax.eval_shape(lambda: self.pool.engine.init_cache(B))
+            entries = []
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tpl)[0]:
+                kind = model_common.cache_leaf_kind(path)
+                keys = tuple(p.key for p in path)
+                entries.append((keys, kind, jax.tree_util.keystr(path),
+                                tuple(leaf.shape)))
+            self._tpl_memo[B] = entries
+        return self._tpl_memo[B]
+
+    def build_cache(self, lengths_np, table_np):
+        """The paged cache tree a decode window / suffix prefill applies
+        with: KV leaves ARE the pool arena (by reference — zero copy),
+        ``cache_index`` carries per-row lengths, and a ``page_table``
+        leaf rides next to it (scan-stacked models broadcast both across
+        the layer axis, which ``nn.scan`` splits per layer)."""
+        B, T = table_np.shape
+        lengths_np = np.asarray(lengths_np, np.int32)
+        table_np = np.asarray(table_np, np.int32)
+        root: dict = {}
+
+        def insert(keys, val):
+            d = root
+            for k in keys[:-1]:
+                d = d.setdefault(k, {})
+            d[keys[-1]] = val
+
+        for keys, kind, kstr, shape in self._template(B):
+            if kind == "kv":
+                insert(keys, self.pool.pages[kstr])
+            elif kind == "index":
+                insert(keys, jnp.asarray(
+                    np.broadcast_to(lengths_np, shape + (B,))))
+                insert(keys[:-1] + (model_common.PAGE_TABLE_LEAF,),
+                       jnp.asarray(
+                           np.broadcast_to(table_np, shape + (B, T))))
+            else:     # unreachable: pool construction validated the tree
+                raise ValueError(f"cache leaf {kstr} outside the "
+                                 f"append_kv_cache contract")
+        return root
+
+    def decode_cache(self):
+        return self.build_cache(self.lengths, self.table)
+
+    def adopt(self, cache) -> None:
+        """Rebind the pool arena to the buffers a jitted call returned —
+        required after every call that took the arena donated (suffix
+        prefills, decode windows): the donated inputs are dead."""
+        pages = self.pool.pages
+        for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+            if model_common.cache_leaf_kind(path) == "kv":
+                pages[jax.tree_util.keystr(path)] = leaf
+
+    # ------------------------------------------------------------------
+    def _telemetry_status(self) -> dict:
+        return {
+            "page_tokens": self.pt,
+            "table_width": self.T,
+            "gen_limit": self.gen_limit,
+            "slot_pages": self._slot_pages_n,
+            "lengths": [int(x) for x in self.lengths],
+            # per-INSTANCE ints, not registry totals: counters are
+            # process-wide and a second batcher must not report this
+            # one's work
+            "admissions": self._admissions,
+            "copy_bytes_saved": self._copy_bytes_saved,
+            "ref_donated_pages": self._ref_donated,
+        }
+
+
+def resolve_paged_decode(engine, prefix_cache, n_slots: int, specdec=None,
+                         override=None) -> Optional[PagedServingState]:
+    """Resolve the batcher's page-resident serving mode.
+
+    Default ON whenever a prefix cache is resolved — the arena already
+    exists, and reading it in place strictly dominates materializing
+    contiguous copies.  ``DSTPU_PAGED_DECODE=0`` is the operator kill
+    switch back to the gather path; an explicit ``False`` (the
+    ``ContinuousBatcher(paged_decode=...)`` argument or the engine
+    config) opts out programmatically.  Falls back (warned, never fatal)
+    when the pool is too small for ``n_slots`` worst-case page chains,
+    when speculative decoding is active (its verify step drives the
+    contiguous slot-cache layout), or when the model family's decode
+    path cannot consume a paged cache (the abstract-trace probe below)."""
+    env = os.environ.get(PAGED_DECODE_ENV, "").strip().lower()
+    if env in ("0", "false", "off"):
+        return None
+    if prefix_cache is None:
+        return None
+    cfg = override if override is not None else \
+        getattr(engine.config, "paged_decode", None)
+    if cfg is False:
+        return None
+    if specdec is not None:
+        logger.warning(
+            "paged decode disabled: speculative decoding's verify step "
+            "drives the contiguous slot-cache layout; slots keep the "
+            "gather path")
+        return None
+    try:
+        state = PagedServingState(prefix_cache, engine, n_slots)
+    except ValueError as e:
+        logger.warning(f"paged decode disabled: {e}")
+        return None
+    # contract probe: a family that consumes the appended cache leaves
+    # DIRECTLY instead of through cached_decode_attention (gptneo's
+    # windowed-mask math) crashes on the PagedKV carriers the paged
+    # append returns — trace ONE abstract decode tick over the paged
+    # tree and fall back to the (correct, pre-existing) gather path
+    # rather than failing at first admission
+    def _probe(p, c, t, q):
+        out, vars_ = engine._decode_model.apply(
+            {"params": p, "cache": c}, t, position_ids=q[:, None],
+            mutable=["cache"])
+        return out["logits"], vars_     # plain JAX types for eval_shape
+
+    try:
+        jax.eval_shape(
+            _probe, engine.params, state.decode_cache(),
+            jax.ShapeDtypeStruct((n_slots, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_slots,), jnp.int32))
+    except Exception as e:
+        logger.warning(
+            f"paged decode disabled: this model family's decode path "
+            f"does not consume a paged cache "
+            f"({type(e).__name__}: {str(e)[:160]}); slots keep the "
+            f"gather path")
+        state.pool.free([state.trash])   # roll back the reservation
+        return None
+    return state

@@ -36,6 +36,7 @@ from ..telemetry.registry import pct as _pct
 from . import kvreuse
 from . import specdec as specdec_mod
 from .engine import InferenceEngine, _sample
+from ..utils.logging import logger
 
 # per-output-token latency lands anywhere from sub-ms (fused TPU ticks)
 # to seconds (CPU-mesh tests); ms-denominated buckets spanning both
@@ -72,7 +73,8 @@ class ContinuousBatcher:
                  pad_token_id: Optional[int] = None, seed: int = 0,
                  chunked_prefill: bool = True,
                  prefill_ahead: Optional[int] = None,
-                 prefix_cache=None, specdec=None, slo=None):
+                 prefix_cache=None, specdec=None, paged_decode=None,
+                 slo=None):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
@@ -96,11 +98,20 @@ class ContinuousBatcher:
         self.specdec = specdec_mod.resolve_specdec(engine, specdec)
         if self.specdec is not None:
             self.specdec.attach(self)
+        # page-resident serving (inference/kvreuse.py + the paged
+        # attention kernel): slots keep their K/V in the prefix cache's
+        # page arena for their whole life — admission gathers nothing
+        # and builds no contiguous admission cache, decode attention
+        # reads the arena in place, retirement donates pages by
+        # reference.  None when disabled or unsupported — and then every
+        # path below is byte-for-byte the pre-existing contiguous
+        # machinery.
+        self.paged = kvreuse.resolve_paged_decode(
+            engine, self.prefix_cache, n_slots, self.specdec, paged_decode)
         cfg = engine.decode_cfg
         self._vocab = int(getattr(cfg, "padded_vocab_size", None)
                           or cfg.vocab_size)
 
-        cache1 = engine.init_cache(1)
         # per-leaf batch axis of the engine cache (scan-stacked layers put
         # batch at dim 1, plain stacks at dim 0, cache_index is a scalar):
         # diff the abstract shapes of a 1-row vs 2-row cache
@@ -110,9 +121,16 @@ class ContinuousBatcher:
             lambda a, b: next((d for d in range(len(a.shape))
                                if a.shape[d] != b.shape[d]), None),
             c1_sds, c2_sds)
-        self._cache = jax.tree_util.tree_map(
-            lambda l: jnp.broadcast_to(l, (n_slots,) + l.shape) + jnp.zeros_like(l),
-            cache1)
+        if self.paged is None:
+            cache1 = engine.init_cache(1)
+            self._cache = jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(l, (n_slots,) + l.shape)
+                + jnp.zeros_like(l), cache1)
+        else:
+            # the slots' K/V lives in the pool arena: allocating the
+            # n_slots × gen-limit contiguous cache would double the HBM
+            # the paged layout exists to reclaim
+            self._cache = None
         self._token = jnp.zeros((n_slots, 1, 1), jnp.int32)
         self._pos = jnp.zeros((n_slots,), jnp.int32)
         self._temp = jnp.zeros((n_slots,), jnp.float32)
@@ -136,6 +154,10 @@ class ContinuousBatcher:
         # released.  ``prefill_ahead`` bounds how many rows may park at
         # once; 0 disables.
         self._parked: deque = deque()
+        # page-resident mode: parked/active page ownership rides keyed by
+        # uid (the parked tuple keeps the contiguous shape with cacheB
+        # None, so every shared code path unpacks identically)
+        self._parked_meta: Dict[int, object] = {}
         self.prefill_ahead = n_slots if prefill_ahead is None \
             else int(prefill_ahead)
         self._tick_no = 0
@@ -227,27 +249,39 @@ class ContinuousBatcher:
         # params are an explicit broadcast argument (in_axes=None), NOT a
         # closure capture: captured arrays serialize as literals in the
         # compile payload (fatal over a remote-compile tunnel at 124M+)
+        def sample_row(greedy, logits, slot_id, temp, top_p, rep, seen,
+                       done, tick, eos, pad):
+            """THE per-row sampling step — fold_in key discipline, the
+            greedy override, done→pad masking, EOS latch, seen scatter —
+            shared by the slot-vmapped contiguous step AND the batched
+            paged step, so paged↔gather byte-identity cannot drift on a
+            one-sided edit.  Greedy pools take the STATIC temperature=0
+            sampler: with traced temp/top_p the nucleus path stays live
+            and costs a (V,)-sort per slot per tick — ~10 ms/tick of
+            pure dead code at 8×50k vocab when every request is greedy
+            anyway."""
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(base_seed), tick),
+                slot_id)
+            nxt = _sample(logits, key, 0.0 if greedy else temp,
+                          top_k_static, 1.0 if greedy else top_p,
+                          rep, seen)
+            nxt = jnp.where(done, pad, nxt)
+            new_done = jnp.logical_or(done, nxt == eos)
+            seen = seen.at[jnp.arange(1), nxt].set(True)
+            return nxt, seen, new_done
+
         def make_slot_step(greedy: bool):
             def slot_step(params, cache, token, pos, slot_id, temp, top_p,
                           rep, seen, done, tick, eos, pad):
-                key = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.PRNGKey(base_seed), tick),
-                    slot_id)
                 out, vars_ = decode_model.apply(
                     {"params": params, "cache": cache}, token,
                     position_ids=jnp.full((1, 1), pos, jnp.int32),
                     mutable=["cache"])
                 logits = out["logits"][:, -1, :].astype(jnp.float32)  # (1,V)
-                # greedy pools take the STATIC temperature=0 sampler: with
-                # traced temp/top_p the nucleus path stays live and costs a
-                # (V,)-sort per slot per tick — ~10 ms/tick of pure dead
-                # code at 8×50k vocab when every request is greedy anyway
-                nxt = _sample(logits, key, 0.0 if greedy else temp,
-                              top_k_static, 1.0 if greedy else top_p,
-                              rep, seen)
-                nxt = jnp.where(done, pad, nxt)
-                new_done = jnp.logical_or(done, nxt == eos)
-                seen = seen.at[jnp.arange(1), nxt].set(True)
+                nxt, seen, new_done = sample_row(
+                    greedy, logits, slot_id, temp, top_p, rep, seen,
+                    done, tick, eos, pad)
                 return nxt, vars_["cache"], seen, new_done
             return slot_step
 
@@ -390,6 +424,123 @@ class ContinuousBatcher:
         self._retire_fn = recompile.watch(
             jax.jit(retire_fn, donate_argnums=(2,)), name="serving.retire")
 
+        if self.paged is not None:
+            # -- page-resident decode path -----------------------------
+            # One BATCHED model forward per tick instead of the slot
+            # vmap: the shared page arena cannot ride a vmapped cache
+            # (each lane would get its own mutated copy), so the paged
+            # cache tree — arena by reference + per-row lengths + page
+            # table — applies at B=n_slots and only the SAMPLER is
+            # vmapped, reproducing make_slot_step's per-row semantics
+            # (same fold_in keys, same _sample) token-for-token.
+            def make_paged_step(greedy: bool):
+                # the SAME sample_row as the contiguous slot step —
+                # vmapped over rows here instead of riding the slot vmap
+                row_sample = functools.partial(sample_row, greedy)
+
+                def paged_step(params, cache, token, pos, slot_ids, temp,
+                               top_p, rep, seen, done, tick, eos, pad):
+                    out, vars_ = decode_model.apply(
+                        {"params": params, "cache": cache},
+                        token[:, :, 0], position_ids=pos[:, None],
+                        mutable=["cache"])
+                    logits = out["logits"][:, -1:, :].astype(jnp.float32)
+                    nxt, seen, new_done = jax.vmap(
+                        row_sample,
+                        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None))(
+                        logits, slot_ids, temp, top_p, rep, seen, done,
+                        tick, eos, pad)
+                    return nxt, vars_["cache"], seen, new_done
+                return paged_step
+
+            paged_steps = {g: make_paged_step(g) for g in (False, True)}
+
+            # the sampling loop state (token/pos/seen/done) cycles
+            # between three producers — place, retire, decode window —
+            # and XLA's sharding propagation is free to shard a
+            # singleton axis differently in each (observed: the window
+            # returned ``done`` as P(None, 'tp') while place returned
+            # P()), which costs one spurious window recompile per
+            # (ticks, greedy) site.  Force every producer's loop-state
+            # OUTPUTS replicated via out_shardings — a
+            # with_sharding_constraint does not work here: sharding a
+            # size-1 axis is "compatible" with replicated, so GSPMD may
+            # still pick the sharded form for the executable's output
+            # signature.  These are (n_slots,)-small arrays; replication
+            # is free.
+            _repl = jax.sharding.NamedSharding(
+                engine.mesh, jax.sharding.PartitionSpec())
+
+            @functools.lru_cache(maxsize=None)
+            def paged_multi_step(ticks: int, greedy: bool = False):
+                pstep = paged_steps[greedy]
+
+                def run(params, cache, token, pos, slot_ids, temp, top_p,
+                        rep, seen, done, tick0, eos, pad):
+                    def body(carry, t):
+                        cache, token, pos, seen, done = carry
+                        tok, cache, seen, done = pstep(
+                            params, cache, token, pos, slot_ids, temp,
+                            top_p, rep, seen, done, tick0 + t, eos, pad)
+                        return (cache, tok[:, :, None], pos + 1, seen,
+                                done), tok
+                    (cache, token, pos, seen, done), toks = jax.lax.scan(
+                        body, (cache, token, pos, seen, done),
+                        jnp.arange(ticks))
+                    return toks, cache, token, pos, seen, done
+
+                # the cache (and with it the ARENA) is DONATED: the
+                # append must bufferize in place — without donation XLA
+                # copies the whole arena per window, the exact copy tax
+                # paged attention removes.  The caller rebinds via
+                # PagedServingState.adopt.
+                return recompile.watch(
+                    jax.jit(run, donate_argnums=(1,),
+                            out_shardings=(None, None, _repl, _repl,
+                                           _repl, _repl)),
+                    name=f"serving.decode_paged"
+                         f"[{ticks}{'g' if greedy else 's'}]")
+
+            self._paged_multi_step = paged_multi_step
+
+            def paged_place_fn(token, pos, temp, top_p, rep, seen, done,
+                               firstB, seen1B, row, prompt_len, i,
+                               r_temp, r_top_p, r_rep):
+                # no cache scatter: the request's K/V is ALREADY in the
+                # arena (its suffix prefill wrote it there) — placement
+                # is sampling-state bookkeeping only
+                first1 = jax.lax.dynamic_slice_in_dim(firstB, row, 1, 0)
+                seen1 = jax.lax.dynamic_slice_in_dim(seen1B, row, 1, 0)
+                first = first1[0]
+                seen_row = seen1[0]
+
+                def put(big, small):
+                    return jax.lax.dynamic_update_slice(
+                        big, small[None].astype(big.dtype),
+                        (i,) + (0,) * small.ndim)
+
+                token = put(token, first[:, None])
+                pos = put(pos, jnp.int32(prompt_len))
+                temp = put(temp, r_temp)
+                top_p = put(top_p, r_top_p)
+                rep = put(rep, r_rep)
+                seen = put(seen, seen_row)
+                done = put(done, first == jnp.int32(self.eos))
+                return token, pos, temp, top_p, rep, seen, done
+
+            self._paged_place_fn = recompile.watch(
+                jax.jit(paged_place_fn, out_shardings=_repl),
+                name="serving.place_paged", warn=False)
+
+            def paged_retire_fn(done, pos, i):
+                # the cache-side rewind is host bookkeeping (table row →
+                # trash, length → 0) in PagedServingState.retire_slot
+                return done.at[i, 0].set(True), pos.at[i].set(0)
+
+            self._paged_retire_fn = recompile.watch(
+                jax.jit(paged_retire_fn, out_shardings=_repl),
+                name="serving.retire_paged")
+
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
                top_p: float = 1.0, repetition_penalty: float = 1.0) -> int:
@@ -404,6 +555,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
                 f"exceeds the generation limit {self.engine._gen_limit}")
+        # no paged-capacity check needed here: the gen-limit guard above
+        # caps any request's page chain at ceil(gen_limit/page_tokens)
+        # pages, and PagedServingState's construction floor guarantees
+        # the pool holds n_slots of those — a request that passes the
+        # gen-limit check always fits
         uid = self._next_uid
         self._next_uid += 1
         self._queue.append(Request(uid, prompt, max_new_tokens,
@@ -490,6 +646,7 @@ class ContinuousBatcher:
             "gen_limit": int(self.engine._gen_limit),
             "parked_bytes": int(self._m_parked_bytes.value),
             "prefix_cache": self.prefix_cache is not None,
+            "paged_decode": self.paged is not None,
             "specdec": self.specdec is not None,
             "in_flight_uids": self._active_uids(),
             "tpot_ms": None if not self._tpot_window else round(
@@ -518,7 +675,8 @@ class ContinuousBatcher:
         self._tpot_window.append(ms)
 
     # ------------------------------------------------------------------
-    def _prefill(self, ids, cache=None, start: int = 0, uids=None):
+    def _prefill(self, ids, cache=None, start: int = 0, uids=None,
+                 donate: bool = False):
         """Prefill of ``ids`` (B, S) — B prompts of equal length — into
         ``cache`` (a fresh B-row cache when None) at positions
         ``[start, start + S)``.
@@ -535,8 +693,16 @@ class ContinuousBatcher:
         prefill executables per batch width instead of one per distinct
         length — each chunk appends at its exact positions, so the cache
         stays exact (no pad pollution).  Returns (last-chunk logits,
-        cache)."""
+        cache).
+
+        ``donate=True`` runs the cache-donating prefill executable — the
+        page-resident path, whose cache tree carries the SHARED page
+        arena: without donation every chunk would copy the whole arena
+        to apply an O(chunk) append.  The caller must rebind the arena
+        from the returned cache (``PagedServingState.adopt``)."""
         eng = self.engine
+        prefill_fn = eng._compiled_prefill_donated if donate \
+            else eng._compiled_prefill
         S = ids.shape[1]
         if start and cache is None:
             # an offset prefill writes at positions [start, start+S) of a
@@ -558,8 +724,7 @@ class ContinuousBatcher:
             if not self.chunked_prefill:
                 positions = jnp.asarray(
                     np.arange(start, start + S, dtype=np.int32))[None, :]
-                return eng._compiled_prefill(eng.params, cache, ids,
-                                             positions)
+                return prefill_fn(eng.params, cache, ids, positions)
             pos = 0
             logits = None
             chunk = 1 << (S.bit_length() - 1)
@@ -569,8 +734,8 @@ class ContinuousBatcher:
                     positions = jnp.asarray(np.arange(
                         start + pos, start + pos + chunk,
                         dtype=np.int32))[None, :]
-                    logits, cache = eng._compiled_prefill(eng.params, cache,
-                                                          seg, positions)
+                    logits, cache = prefill_fn(eng.params, cache, seg,
+                                               positions)
                     pos += chunk
                 chunk >>= 1
             return logits, cache
@@ -600,7 +765,15 @@ class ContinuousBatcher:
         a burst sharing a system prompt matches the same pages and still
         batches into one prefill.  Reuse is exact-match only, and the
         match is capped one token short of the prompt — the real last
-        token always runs through prefill to produce sampling logits."""
+        token always runs through prefill to produce sampling logits.
+
+        Page-resident mode (``self.paged``) takes
+        :meth:`_prefill_batch_paged` instead: the suffix prefill writes
+        STRAIGHT into freshly allocated arena pages through the
+        request's page table, and the hit prefix is never copied at
+        all — admission is page-ref bookkeeping."""
+        if self.paged is not None:
+            return self._prefill_batch_paged(max_new)
         pc = self.prefix_cache
         while self._queue and max_new > 0:
             if pc is not None:
@@ -704,6 +877,154 @@ class ContinuousBatcher:
                     (req, cacheB, row, firstB, seen1B, first_host))
         self._update_occupancy_gauges()
 
+    def _prefill_batch_paged(self, max_new: int):
+        """Page-resident admission (the ``_prefill_batch`` analog): no
+        ``gather_pages``, no contiguous admission cache.
+
+        Per group (same matched pages + same suffix pow2 bucket, exactly
+        the contiguous grouping rule): each request allocates its own
+        pages covering ``[m0, prompt+max_new)`` (``try_admit`` — the hit
+        chain is pinned for the request's lifetime), the batched suffix
+        prefill applies a cache tree whose K/V leaves ARE the pool arena
+        (by reference, donated — the append scatters O(suffix) rows into
+        the new pages in place), and the parked entry carries only the
+        sampling-side arrays: placement is bookkeeping, the K/V never
+        moves again.  Page exhaustion re-queues the un-admitted tail and
+        stops (backpressure; ``submit`` already rejected requests that
+        could never fit)."""
+        pc = self.prefix_cache
+        pg = self.paged
+        blocked = False
+        while self._queue and max_new > 0 and not blocked:
+            m0, pids0, nodes0 = pc.match(self._queue[0].prompt)
+            sfx0 = len(self._queue[0].prompt) - m0
+            bucket = 1 << (sfx0 - 1).bit_length()
+            bucketed = self.chunked_prefill and \
+                m0 + bucket <= self.engine._gen_limit
+
+            def same_group(r):
+                m, pids, _ = pc.match(r.prompt)
+                if pids != pids0:
+                    return False
+                s = len(r.prompt) - m
+                if bucketed:
+                    return 1 << (s - 1).bit_length() == bucket
+                return s == sfx0
+
+            reqs = [self._queue.popleft()]
+            while (self._queue and len(reqs) < max_new
+                   and same_group(self._queue[0])):
+                reqs.append(self._queue.popleft())
+            max_new -= len(reqs)
+            admitted, metas = [], []
+            while reqs:
+                r = reqs[0]
+                # span covers prompt + generation; bucket-pad overshoot
+                # past it resolves to the table's trash entries
+                meta = pg.try_admit(
+                    r.prompt, r.max_new_tokens, m0, nodes0, pids0,
+                    span_tokens=min(len(r.prompt) + r.max_new_tokens,
+                                    pg.gen_limit))
+                if meta is None:
+                    # out of pages even after eviction: return the tail
+                    # to the queue head IN ORDER and stop admitting
+                    self._queue.extendleft(reversed(reqs))
+                    blocked = True
+                    break
+                admitted.append(reqs.pop(0))
+                metas.append(meta)
+            if not admitted:
+                break
+            B = len(admitted)
+            lens = np.asarray([len(r.prompt) - m0 for r in admitted],
+                              np.int32)
+            for row, r in enumerate(admitted):
+                self._note_lifecycle(r.uid, "prefill_start",
+                                     hit_tokens=int(m0),
+                                     prefill_tokens=int(lens[row]),
+                                     batch=B)
+            # metas[:consumed] have found an owner (parked or released);
+            # an exception anywhere before that — prefill, sampling, the
+            # device fetch — rolls the REST back (free + unpin, NO tree
+            # absorb: pre-prefill the pages hold no/partial K/V,
+            # post-prefill the tree simply never learns about them), or
+            # a transient flake leaks lifetime-pinned radix nodes and
+            # arena pages until admission deadlocks.  The rollback
+            # recovers HOST bookkeeping only: if the failure happened
+            # after the prefill executable consumed the DONATED arena
+            # (mid-chunk device fault), pool.pages holds dead buffers
+            # and this batcher cannot continue — the except warns
+            # loudly; rebuild engine+batcher (the bench _retry pattern,
+            # same hazard class as the contiguous path's donated decode
+            # windows).
+            consumed = 0
+            try:
+                # the suffix-prefill cache tree: arena by reference,
+                # per-row write head at m0, each request's table row
+                cacheB = pg.build_cache(
+                    np.full((B,), m0, np.int32),
+                    np.stack([m.table_row for m in metas]))
+                if bucketed and (lens != lens[0]).any():
+                    ids_np = np.full((B, bucket), self.pad, np.int32)
+                    for row, r in enumerate(admitted):
+                        ids_np[row, :lens[row]] = r.prompt[m0:]
+                    logits, cacheB = self._prefill(
+                        jnp.asarray(ids_np), cache=cacheB, start=m0,
+                        uids=[r.uid for r in admitted], donate=True)
+                    last = logits[np.arange(B),
+                                  np.asarray(lens) - 1][:, None]
+                else:
+                    ids = jnp.asarray(np.stack([r.prompt[m0:]
+                                                for r in admitted]))
+                    logits, cacheB = self._prefill(
+                        ids, cache=cacheB, start=m0,
+                        uids=[r.uid for r in admitted], donate=True)
+                    last = logits[:, -1:, :]
+                # the donated arena is dead; rebind to the returned buffers
+                pg.adopt(cacheB)
+                pc.note_tokens(hit=m0 * B, miss=int(lens.sum()))
+                prompt_seen = np.zeros((B, 1, self._vocab), bool)
+                for row, req in enumerate(admitted):
+                    prompt_seen[row, 0, req.prompt] = True
+                firstB, seen1B = self._first_token_batch(
+                    last, jnp.asarray(prompt_seen),
+                    jnp.asarray([r.uid for r in admitted], jnp.int32),
+                    jnp.asarray([r.temperature for r in admitted],
+                                jnp.float32),
+                    jnp.asarray([r.top_p for r in admitted], jnp.float32),
+                    jnp.asarray([r.repetition_penalty for r in admitted],
+                                jnp.float32))
+                first_hostB = np.asarray(jax.device_get(firstB))[:, 0]
+                t_first = time.perf_counter()
+                for row, req in enumerate(admitted):
+                    self._t_first[req.uid] = t_first
+                    self._note_lifecycle(req.uid, "first_token")
+                    first_host = int(first_hostB[row])
+                    if first_host == self.eos or req.max_new_tokens <= 1:
+                        pg.finish_unslotted(metas[row], req.prompt)
+                        consumed = row + 1
+                        self._finish_unslotted(req, [first_host])
+                        continue
+                    self._parked_meta[req.uid] = metas[row]
+                    consumed = row + 1
+                    # no cacheB in the parked entry: the K/V already
+                    # lives in the arena, owned by the meta in
+                    # _parked_meta
+                    self._parked.append(
+                        (req, None, row, firstB, seen1B, first_host))
+            except Exception:
+                for meta in metas[consumed:]:
+                    pg.abort_admit(meta)
+                if any(getattr(l, "is_deleted", lambda: False)()
+                       for l in pg.pool.pages.values()):
+                    logger.warning(
+                        "paged admission failed AFTER the prefill "
+                        "consumed the donated page arena: this batcher "
+                        "cannot continue serving — rebuild the engine "
+                        "and batcher before retrying")
+                raise
+        self._update_occupancy_gauges()
+
     def _record_latency(self, uid: int, n_out: int = 0) -> None:
         """Collapse a retired request's in-flight timestamps into the
         bounded (ttft, e2e) window and the registry histograms, tag the
@@ -764,13 +1085,27 @@ class ContinuousBatcher:
             req, cacheB, row, firstB, seen1B, first_host = \
                 self._parked.popleft()
             i = free.pop(0)
-            (self._cache, self._token, self._pos, self._temp,
-             self._top_p, self._rep, self._seen, self._done) = \
-                self._place_fn(
-                    self._cache, self._token, self._pos, self._temp,
-                    self._top_p, self._rep, self._seen, self._done,
-                    cacheB, firstB, seen1B, row, len(req.prompt), i,
-                    req.temperature, req.top_p, req.repetition_penalty)
+            if self.paged is not None:
+                # K/V is already page-resident: placement scatters only
+                # the sampling state, then binds the slot's table row
+                (self._token, self._pos, self._temp, self._top_p,
+                 self._rep, self._seen, self._done) = \
+                    self._paged_place_fn(
+                        self._token, self._pos, self._temp, self._top_p,
+                        self._rep, self._seen, self._done,
+                        firstB, seen1B, row, len(req.prompt), i,
+                        req.temperature, req.top_p,
+                        req.repetition_penalty)
+                self.paged.place(i, self._parked_meta.pop(req.uid))
+            else:
+                (self._cache, self._token, self._pos, self._temp,
+                 self._top_p, self._rep, self._seen, self._done) = \
+                    self._place_fn(
+                        self._cache, self._token, self._pos, self._temp,
+                        self._top_p, self._rep, self._seen, self._done,
+                        cacheB, firstB, seen1B, row, len(req.prompt), i,
+                        req.temperature, req.top_p,
+                        req.repetition_penalty)
             self._slots[i] = _Active(req, [first_host])
             self._note_lifecycle(req.uid, "place", slot=i)
         self._shrink_parked()
@@ -785,6 +1120,10 @@ class ContinuousBatcher:
         reference — worst-case parked residency falls from B rows to 1
         per drained batch.  (One extra device dispatch per batch, paid
         only when B > 1.)"""
+        if self.paged is not None:
+            # paged parked entries hold no cacheB — their K/V is arena-
+            # resident; only the small (B, 1[, V]) sampling arrays park
+            return
         refs: Dict[int, int] = {}
         for entry in self._parked:
             refs[id(entry[1])] = refs.get(id(entry[1]), 0) + 1
@@ -802,6 +1141,16 @@ class ContinuousBatcher:
             [act.req.prompt, np.asarray(act.emitted, np.int32)])
         self._record_latency(act.req.uid, n_out=len(act.emitted))
         self._slots[i] = None
+        if self.paged is not None:
+            # zero-copy retirement: the prompt pages ATTACH to the radix
+            # tree by reference (absorb), the rest free; the device side
+            # is untouched — next window's table/lengths simply stop
+            # naming this slot
+            self.paged.retire_slot(i, act.req.prompt)
+            self._done, self._pos = self._paged_retire_fn(
+                self._done, self._pos, i)
+            self._update_occupancy_gauges()
+            return
         if self.prefix_cache is not None:
             # donate the prompt-prefix pages BEFORE retire_fn: retire
             # donates the cache buffer to XLA, and the copy must read
@@ -998,13 +1347,29 @@ class ContinuousBatcher:
             with trace.span("serve/decode-tick", ticks=int(sub),
                             active=len(active),
                             uids=self._active_uids()):
-                toks, self._cache, self._token, self._pos, self._seen, \
-                    done = self._multi_step(int(sub), greedy)(
-                        self.engine.params, self._cache, self._token,
-                        self._pos, slot_ids, self._temp, self._top_p,
-                        self._rep, self._seen, self._done,
-                        jnp.int32(self._tick_no), jnp.int32(self.eos),
-                        jnp.int32(self.pad))
+                if self.paged is not None:
+                    # one BATCHED forward over the arena-backed paged
+                    # cache tree; the arena rides in donated and comes
+                    # back rebound (adopt).  note_window mirrors the
+                    # on-device head advance into the host lengths.
+                    toks, cache, self._token, self._pos, self._seen, \
+                        done = self._paged_multi_step(int(sub), greedy)(
+                            self.engine.params, self.paged.decode_cache(),
+                            self._token, self._pos, slot_ids, self._temp,
+                            self._top_p, self._rep, self._seen,
+                            self._done, jnp.int32(self._tick_no),
+                            jnp.int32(self.eos), jnp.int32(self.pad))
+                    self.paged.adopt(cache)
+                    self.paged.note_window(int(sub))
+                else:
+                    toks, self._cache, self._token, self._pos, \
+                        self._seen, done = self._multi_step(
+                            int(sub), greedy)(
+                            self.engine.params, self._cache, self._token,
+                            self._pos, slot_ids, self._temp, self._top_p,
+                            self._rep, self._seen, self._done,
+                            jnp.int32(self._tick_no), jnp.int32(self.eos),
+                            jnp.int32(self.pad))
                 self._tick_no += int(sub)
                 self._done = done
                 # the fetch is part of the tick's host wall time
@@ -1079,16 +1444,25 @@ class ContinuousBatcher:
         the measured first-token path)."""
         s = 1
         while s <= int(ticks):
-            compiled = self._multi_step(s, greedy).lower(
-                self.engine.params, self._cache, self._token, self._pos,
-                np.arange(self.n_slots), self._temp, self._top_p,
-                self._rep, self._seen, self._done, jnp.int32(0),
-                jnp.int32(self.eos), jnp.int32(self.pad)).compile()
+            if self.paged is not None:
+                compiled = self._paged_multi_step(s, greedy).lower(
+                    self.engine.params, self.paged.decode_cache(),
+                    self._token, self._pos, np.arange(self.n_slots),
+                    self._temp, self._top_p, self._rep, self._seen,
+                    self._done, jnp.int32(0), jnp.int32(self.eos),
+                    jnp.int32(self.pad)).compile()
+                site = f"serving.decode_paged[{s}{'g' if greedy else 's'}]"
+            else:
+                compiled = self._multi_step(s, greedy).lower(
+                    self.engine.params, self._cache, self._token,
+                    self._pos, np.arange(self.n_slots), self._temp,
+                    self._top_p, self._rep, self._seen, self._done,
+                    jnp.int32(0), jnp.int32(self.eos),
+                    jnp.int32(self.pad)).compile()
+                site = f"serving.decode[{s}{'g' if greedy else 's'}]"
             # the AOT compile is the one place a Compiled handle exists:
             # publish its per-device HBM breakdown (telemetry/memory.py)
-            telemetry_memory.record_compiled(
-                compiled,
-                site=f"serving.decode[{s}{'g' if greedy else 's'}]")
+            telemetry_memory.record_compiled(compiled, site=site)
             s <<= 1
         if admission:
             self._warmup_admission()
@@ -1114,8 +1488,18 @@ class ContinuousBatcher:
                 self._first_token_batch.lower(
                     logits, seen, uids, f32, f32, f32).compile(),
                 site=f"serving.first_token[{B}]")
-            cacheB = jax.eval_shape(lambda: self.engine.init_cache(B))
             firstB = sds((B, 1), jnp.int32)
+            if self.paged is not None:
+                # no cacheB operands in paged placement (no admission
+                # cache exists); extract_row never runs either
+                telemetry_memory.record_compiled(
+                    self._paged_place_fn.lower(
+                        self._token, self._pos, self._temp, self._top_p,
+                        self._rep, self._seen, self._done,
+                        firstB, seen, 0, 1, 0, 0.0, 1.0, 1.0).compile(),
+                    site=f"serving.place_paged[{B}]")
+                continue
+            cacheB = jax.eval_shape(lambda: self.engine.init_cache(B))
             telemetry_memory.record_compiled(
                 self._place_fn.lower(
                     self._cache, self._token, self._pos, self._temp,

@@ -210,17 +210,24 @@ def declare_fused_proj(module: nn.Module, cfg, name: str, names: tuple,
 # the string match, so a renamed leaf breaks loudly in one place.
 KV_CACHE_LEAVES = ("cached_key", "cached_value")
 CACHE_INDEX_LEAF = "cache_index"
+# present only in PAGED caches (inference/kvreuse.py builds them): the
+# per-row page table mapping token range [j*pt, (j+1)*pt) to an arena
+# page.  Its presence is how append_kv_cache detects paged mode.
+PAGE_TABLE_LEAF = "page_table"
 
 
 def cache_leaf_kind(path) -> Optional[str]:
-    """``"kv"`` (a paged K/V buffer), ``"index"`` (the write head) or
-    ``None`` (unknown — present only in models outside the
+    """``"kv"`` (a K/V buffer — per-slot contiguous or the paged arena),
+    ``"index"`` (the write head), ``"table"`` (a paged cache's page
+    table) or ``None`` (unknown — present only in models outside the
     ``append_kv_cache`` contract) for a cache-collection tree path."""
     key = getattr(path[-1], "key", None)
     if key in KV_CACHE_LEAVES:
         return "kv"
     if key == CACHE_INDEX_LEAF:
         return "index"
+    if key == PAGE_TABLE_LEAF:
+        return "table"
     return None
 
 
@@ -246,8 +253,17 @@ def append_kv_cache(module: nn.Module, k: jax.Array, v: jax.Array,
     ``cache`` collection (the reference softmax.cu context-cache analog)
     and return ``(k_cache, v_cache, cur)`` — the ONE cache layout shared
     by every decoder family and by both the XLA and fused decode paths,
-    so it cannot drift between them."""
+    so it cannot drift between them.
+
+    When the supplied cache carries a ``page_table`` variable (a PAGED
+    cache, built by ``inference/kvreuse.py``), the append instead writes
+    each row's new K/V into its tail page IN PLACE and returns
+    ``(PagedKV, PagedKV, lengths)`` — ``cached_decode_attention``
+    dispatches on the type, so every family's call site serves both
+    layouts unchanged."""
     B, S, H, D = k.shape
+    if module.has_variable("cache", PAGE_TABLE_LEAF):
+        return _append_paged_kv_cache(module, k, v, cache_len, dtype)
     ck = module.variable("cache", "cached_key", jnp.zeros,
                          (B, cache_len, H, D), dtype)
     cv = module.variable("cache", "cached_value", jnp.zeros,
@@ -261,6 +277,42 @@ def append_kv_cache(module: nn.Module, k: jax.Array, v: jax.Array,
         cv.value, v.astype(dtype), (0, cur, 0, 0))
     idx.value = cur + S
     return ck.value, cv.value, cur
+
+
+def _append_paged_kv_cache(module: nn.Module, k: jax.Array, v: jax.Array,
+                           cache_len: int, dtype):
+    """Paged append: the cache's ``cached_key``/``cached_value`` leaves
+    are the SHARED page arena ``(P, pt, KV, D)``, ``page_table`` is
+    ``(B, T)`` and ``cache_index`` is per-row lengths ``(B,)``.  The new
+    K/V lands at each row's write head through the table — a scatter of
+    O(new tokens), not O(history); the arena updates in place under the
+    caller's donation.  Rows whose head has run past their allocation
+    (retired slots ticking to a window boundary, bucket-pad overshoot)
+    resolve to the table's trailing trash entries — never another slot's
+    pages."""
+    from ..ops.pallas.paged_attention import PagedKV
+
+    B, S, H, D = k.shape
+    ck = module.variable("cache", "cached_key", jnp.zeros,
+                         (B, cache_len, H, D), dtype)
+    cv = module.variable("cache", "cached_value", jnp.zeros,
+                         (B, cache_len, H, D), dtype)
+    tab = module.variable("cache", PAGE_TABLE_LEAF,
+                          lambda: jnp.zeros((B, 1), jnp.int32))
+    idx = module.variable("cache", CACHE_INDEX_LEAF,
+                          lambda: jnp.zeros((B,), jnp.int32))
+    lengths = idx.value                                     # (B,)
+    pt = ck.value.shape[1]
+    T = tab.value.shape[-1]
+    pos = lengths[:, None] + jnp.arange(S)[None, :]         # (B, S)
+    blk = jnp.minimum(pos // pt, T - 1)                     # overshoot →
+    pids = jnp.take_along_axis(tab.value, blk, axis=1)      # trash entry
+    offs = pos % pt
+    ck.value = ck.value.at[pids, offs].set(k.astype(dtype))
+    cv.value = cv.value.at[pids, offs].set(v.astype(dtype))
+    idx.value = lengths + S
+    return (PagedKV(ck.value, tab.value, cache_len),
+            PagedKV(cv.value, tab.value, cache_len), lengths)
 
 
 # ---------------------------------------------------------------------------
@@ -288,17 +340,28 @@ def decode_fused_mode(cfg) -> Optional[str]:
     """``None`` (off) | ``"kernel"`` (TPU) | ``"interpret"`` (non-TPU:
     the interpreter runs the same kernels for CPU-mesh parity/smoke).
 
-    ``DS_TPU_DECODE_FUSED=0/false/off`` force-disables;
-    ``=1/true/on`` force-enables over a False config flag."""
+    Default flipped ON for TPU hardware after the round-8 e2e sweep (the
+    megakernels are also what restores the W8A16 bandwidth win — the
+    dequant epilogue fuses into the contraction).  The flip is
+    tri-state so the sweep's verdict and explicit opt-outs coexist:
+
+    - config flag ``None`` (families' default): ON on TPU, OFF elsewhere
+      (the interpreter runs the same kernels orders of magnitude slower —
+      CPU runs must opt in explicitly);
+    - config flag ``True``/``False``: explicit, wins over the default;
+    - ``DS_TPU_DECODE_FUSED=0/false/off`` force-disables over ANY config
+      (operator kill switch); ``=1/true/on`` force-enables over a False
+      config flag (and picks interpret mode off-TPU)."""
     env = os.environ.get(DECODE_FUSED_ENV, "").lower()
     if env in ("0", "false", "off"):
         return None
-    enabled = bool(getattr(cfg, "decode_fused", False)) or \
-        env in ("1", "true", "on")
-    if not enabled:
-        return None
     from ..ops.attention import on_tpu
 
+    flag = getattr(cfg, "decode_fused", None)
+    enabled = env in ("1", "true", "on") or flag is True or \
+        (flag is None and on_tpu())
+    if not enabled:
+        return None
     return "kernel" if on_tpu() else "interpret"
 
 

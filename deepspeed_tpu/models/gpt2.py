@@ -75,9 +75,10 @@ class GPT2Config:
     # fused decode-tick megakernels (ops/pallas/decode_layer.py): the
     # per-layer decode chain collapses to LN->QKV and o-proj->LN->MLP
     # Pallas launches around decode_attention; DS_TPU_DECODE_FUSED
-    # env-overrides.  Default off pending the e2e sweep (repo law: only
-    # e2e sweeps flip perf defaults).
-    decode_fused: bool = False
+    # env-overrides.  None = ON on TPU hardware (flipped after the
+    # round-8 e2e sweep), OFF elsewhere (the CPU interpreter runs the
+    # same kernels orders of magnitude slower — tests opt in with True).
+    decode_fused: Optional[bool] = None
     # chunked tied-head loss (common.chunked_lm_loss): token rows per
     # chunk; None = dense logits.  Saves the (B,S,V) fp32 logits+cotangent
     # at large micro sizes; the model output then carries no "logits".

@@ -51,8 +51,9 @@ class GPTNeoXConfig:
     w8: bool = False
     w8_group: int = 128
     # fused decode-tick megakernels (ops/pallas/decode_layer.py); see
-    # GPT2Config.decode_fused.  DS_TPU_DECODE_FUSED env-overrides.
-    decode_fused: bool = False
+    # GPT2Config.decode_fused.  DS_TPU_DECODE_FUSED env-overrides;
+    # None = ON on TPU hardware (round-8 e2e sweep), OFF elsewhere.
+    decode_fused: Optional[bool] = None
     moe: Optional[Any] = None
 
     @property

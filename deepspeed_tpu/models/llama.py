@@ -49,8 +49,9 @@ class LlamaConfig:
     w8: bool = False
     w8_group: int = 128
     # fused decode-tick megakernels (ops/pallas/decode_layer.py); see
-    # GPT2Config.decode_fused.  DS_TPU_DECODE_FUSED env-overrides.
-    decode_fused: bool = False
+    # GPT2Config.decode_fused.  DS_TPU_DECODE_FUSED env-overrides;
+    # None = ON on TPU hardware (round-8 e2e sweep), OFF elsewhere.
+    decode_fused: Optional[bool] = None
 
     @property
     def padded_vocab_size(self) -> int:

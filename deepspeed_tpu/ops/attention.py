@@ -186,8 +186,32 @@ def cached_decode_attention(q, k_cache, v_cache, cur, attn_mask=None, *,
     ``q``: (B, S, H, D) new queries; ``k_cache``/``v_cache``:
     (B, S_max, KV, D) caches AFTER the append; ``cur``: scalar cache
     index before the append.
+
+    A PAGED cache (``append_kv_cache``'s paged branch returns
+    :class:`~.pallas.paged_attention.PagedKV` carriers and per-row
+    ``cur``) dispatches to the paged kernel — attention reads the page
+    arena in place, no contiguous materialization — with the
+    gather-read XLA reference as the fallback for multi-token queries,
+    masks, and non-TPU backends.
     """
+    from .pallas.paged_attention import (PagedKV, paged_decode_attention,
+                                         paged_decode_supported,
+                                         paged_reference_attention)
+
     B, S, H, D = q.shape
+    if isinstance(k_cache, PagedKV):
+        pages_k, table = k_cache.pages, k_cache.table
+        pages_v = v_cache.pages
+        pt, KV = pages_k.shape[1], pages_k.shape[2]
+        lengths = cur + S          # (B,) valid tokens after the append
+        if S == 1 and attn_mask is None and on_tpu() and \
+                paged_decode_supported(pt, KV, D, pages_k.dtype.itemsize):
+            return paged_decode_attention(q, pages_k, pages_v, table,
+                                          lengths, scale=scale)
+        return paged_reference_attention(q, pages_k, pages_v, table,
+                                         lengths, scale=scale,
+                                         attn_mask=attn_mask,
+                                         s_kv=k_cache.cache_len)
     S_max, KV = k_cache.shape[1], k_cache.shape[2]
     from .pallas.decode_attention import decode_attention, decode_supported
 

@@ -10,5 +10,6 @@ block-sparse attention lives in ``ops/sparse_attention``; grouped
 quantization in ``ops/quantizer``.
 """
 from .decode_attention import decode_attention  # noqa: F401
+from .paged_attention import paged_decode_attention  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
 from .fused_ops import attention_softmax, bias_gelu, layer_norm  # noqa: F401

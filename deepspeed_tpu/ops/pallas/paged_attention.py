@@ -1,0 +1,290 @@
+"""Paged decode attention: stream the KV page arena in place.
+
+The shared-prefix reuse layer (``inference/kvreuse.py``) keeps K/V in a
+fixed device arena of ``page_tokens``-sized pages, but until now every
+admission *materialized* a contiguous per-slot cache via ``gather_pages``
+before a single decode tick could run — an O(history) copy per admission
+whose HBM cost also bounded how many pages the budget could hold.  This
+kernel is the vLLM-style answer (PagedAttention, Kwon et al.), TPU-shaped:
+decode attention reads the arena **in its native paged layout** through a
+per-slot page table, so a cache-hit admission is pure page-ref
+bookkeeping and the only per-tick arena write is the new token's K/V row
+(``models/common.append_kv_cache``'s paged branch).
+
+Structure is the natural extension of the streamed flash-decode path in
+``decode_attention.py``: the second grid dimension walks *table entries*
+instead of contiguous KV blocks, with the page table and per-slot lengths
+riding as scalar prefetch so each step's DMA fetches exactly the page the
+table names.  Online-softmax state (acc/m/l) lives in VMEM scratch across
+the sequential page walk; entries past the live prefix clamp to the last
+live page (the DMA re-fetches a resident page instead of streaming dead
+traffic) and their compute is skipped.
+
+The op carries the same ``custom_vmap`` fold as ``decode_attention`` so a
+slot-vmapped decode step runs ONE batched kernel over the shared arena
+(the arena operand must be unbatched — it is shared by construction).
+
+Layout contract (derived from the pool, which derives it from
+``append_kv_cache``): ``k_pages``/``v_pages`` are ``(P, pt, KV, D)`` — the
+per-row cache leaf with the batch axis widened to the page count and the
+token axis narrowed to ``page_tokens``.  ``page_table`` is ``(B, T)``
+int32 page ids covering token range ``[j*pt, (j+1)*pt)`` at entry ``j``;
+rows are padded with a trash page past the slot's allocation.
+``lengths`` is ``(B,)`` — valid tokens INCLUDING the just-appended one
+(the ``cur + 1`` convention of ``decode_attention``).
+
+``page_tokens`` is small by default (16) so one page per grid step
+under-fills the DMA pipe on hardware; size ``page_tokens`` >= 64 on real
+chips (``paged_decode_supported`` only enforces the sublane floor).  The
+XLA fallback (:func:`paged_reference_attention`) gathers the table's rows
+into a contiguous view — an attention-side *read* (which attention must
+do anyway), not an admission-time copy — and runs the exact masked jnp
+attention the contiguous path uses, so paged and gathered serving produce
+identical token streams.
+
+``interpret=True`` runs on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+# same scoped-VMEM reasoning as decode_attention: double-buffered K+V page
+# blocks must leave room for q/out/fp32 state
+_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+
+
+class PagedKV(NamedTuple):
+    """A paged K or V cache as ``append_kv_cache`` returns it in paged
+    mode: the arena leaf plus the page table that maps this batch's rows
+    onto it.  ``cache_len`` is the contiguous cache length the model
+    would have used — the gather fallback slices its materialized view to
+    exactly this many tokens so paged and contiguous streams stay
+    byte-identical.  Consumed immediately by ``cached_decode_attention``
+    (never crosses a transform boundary as a pytree)."""
+
+    pages: jax.Array        # (P, pt, KV, D) arena leaf
+    table: jax.Array        # (B, T) int32 page ids
+    cache_len: int          # static: the model's contiguous cache length
+
+
+def paged_decode_supported(page_tokens: int, kv_heads: int, d: int,
+                           itemsize: int) -> bool:
+    """True when the kernel path handles this page geometry: the token
+    axis must satisfy the sublane tile floor and one double-buffered
+    K+V page block must fit the VMEM budget."""
+    return (page_tokens % 8 == 0
+            and 2 * page_tokens * kv_heads * d * itemsize
+            <= _VMEM_BUDGET_BYTES)
+
+
+def _paged_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale, n_heads, n_kv_heads,
+                  pt, n_entries):
+    """Flash-decode over table entries: grid dim 1 walks the page table;
+    each step's (1, pt, KV, D) K/V block IS one arena page, delivered by
+    the index map below."""
+    L = len_ref[pl.program_id(0)]
+    j = pl.program_id(1)
+    group = n_heads // n_kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * pt < L)    # entries wholly past the live prefix: skip
+    def _attend():
+        for kv_h in range(n_kv_heads):
+            sl = pl.ds(kv_h * group, group)
+            q = q_ref[0, 0, sl].astype(jnp.float32) * scale      # (G, D)
+            k = k_ref[0, :, kv_h].astype(jnp.float32)            # (pt, D)
+            v = v_ref[0, :, kv_h].astype(jnp.float32)
+            # the tail page's rows past L are garbage: their k columns
+            # are masked below, but their v rows must be ZEROED — p is 0
+            # there and 0 * inf/NaN would still poison the p @ v matmul
+            row_pos = j * pt + jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0)
+            v = jnp.where(row_pos < L, v, 0.0)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            k_pos = j * pt + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < L, s, NEG_INF)
+            m_old = m_ref[sl, 0]
+            m_new = jnp.maximum(m_old, s.max(axis=-1))
+            m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+            p = jnp.exp(s - m_safe[:, None])
+            corr = jnp.where(m_old == NEG_INF, 0.0, jnp.exp(m_old - m_safe))
+            l_ref[sl, 0] = l_ref[sl, 0] * corr + p.sum(axis=-1)
+            acc_ref[sl, :] = acc_ref[sl, :] * corr[:, None] + \
+                jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            m_ref[sl, 0] = m_new
+
+    @pl.when(j == n_entries - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)   # L == 0 rows: zeros, discarded
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_paged(q, k_pages, v_pages, table, lengths, *, scale, interpret):
+    B, S, H, D = q.shape
+    P, pt, KV = k_pages.shape[0], k_pages.shape[1], k_pages.shape[2]
+    T = table.shape[1]
+    if S != 1:
+        raise ValueError("paged kernel is single-token decode only; the "
+                         "multi-token path rides paged_reference_attention")
+    if H % KV:
+        raise ValueError(f"q heads {H} must be a multiple of KV heads {KV}")
+    if not paged_decode_supported(pt, KV, D, k_pages.dtype.itemsize):
+        raise ValueError(f"unsupported page geometry ({pt}, {KV}, {D})")
+
+    # lengths + table ride as SCALAR PREFETCH so the index maps can place
+    # each grid step's DMA on the page the table names; entries past the
+    # live prefix clamp to the last live entry (a resident-page re-fetch,
+    # not dead HBM traffic) and pl.when skips their compute
+    def _kv_index(b, j, len_ref, tab_ref):
+        jmax = jnp.maximum((len_ref[b] + pt - 1) // pt - 1, 0)
+        jj = jnp.minimum(jnp.minimum(j, jmax), T - 1)
+        return (tab_ref[b, jj], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, T),
+        in_specs=[
+            pl.BlockSpec((1, 1, H, D),
+                         lambda b, j, len_ref, tab_ref: (b, 0, 0, 0)),
+            pl.BlockSpec((1, pt, KV, D), _kv_index),
+            pl.BlockSpec((1, pt, KV, D), _kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, H, D), lambda b, j, len_ref, tab_ref: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),     # acc
+            pltpu.VMEM((H, 128), jnp.float32),   # m (col 0 used)
+            pltpu.VMEM((H, 128), jnp.float32),   # l (col 0 used)
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, n_heads=H,
+                          n_kv_heads=KV, pt=pt, n_entries=T),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+        interpret=interpret,
+    )(lengths, table, q, k_pages, v_pages)
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_op(scale: float, interpret: bool):
+    @jax.custom_batching.custom_vmap
+    def call(q, k_pages, v_pages, table, lengths):
+        return _pallas_paged(q, k_pages, v_pages, table, lengths,
+                             scale=scale, interpret=interpret)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, q, k_pages, v_pages, table, lengths):
+        qb, kb, vb, tb, lb = in_batched
+        if kb or vb:
+            raise NotImplementedError(
+                "paged_decode_attention: the page arena is shared across "
+                "the vmapped axis; batched arenas are unsupported")
+
+        def ensure(x, was):
+            return x if was else jnp.broadcast_to(
+                x[None], (axis_size,) + x.shape)
+
+        q = ensure(q, qb)
+        table = ensure(table, tb)
+        lengths = ensure(lengths, lb)
+        N, B = q.shape[0], q.shape[1]
+        out = call(q.reshape((N * B,) + q.shape[2:]), k_pages, v_pages,
+                   table.reshape((N * B,) + table.shape[2:]),
+                   lengths.reshape(N * B))
+        return out.reshape((N, B) + out.shape[1:]), True
+
+    return call
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_table: jax.Array,
+                           lengths, *, scale: Optional[float] = None,
+                           interpret: bool = False) -> jax.Array:
+    """One decode tick straight off the page arena.
+
+    ``q``: ``(B, 1, H, D)``; ``k_pages``/``v_pages``: ``(P, pt, KV, D)``
+    arena (``KV`` may be smaller than ``H`` — GQA reads KV head
+    ``h // (H/KV)``); ``page_table``: ``(B, T)`` int32; ``lengths``:
+    ``(B,)`` valid tokens per row including the appended one.
+
+    Returns ``(B, 1, H, D)``.
+    """
+    B, _, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    lengths = jnp.broadcast_to(
+        jnp.asarray(lengths, jnp.int32).reshape(-1), (B,))
+    return _paged_op(float(scale), bool(interpret))(
+        q, k_pages, v_pages, page_table, lengths)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback: gather the table's rows into a contiguous view and run the
+# exact masked attention the contiguous path uses.
+# ---------------------------------------------------------------------------
+
+def gather_kv_pages(pages: jax.Array, table: jax.Array) -> jax.Array:
+    """``(P, pt, ...)`` arena + ``(B, T)`` table → ``(B, T*pt, ...)``
+    contiguous view.  A read-side materialization inside the attention
+    computation — NOT an admission-time copy into a persistent cache
+    (``mode="clip"``: table entries are valid page ids by construction,
+    and jnp's default fill mode would poison a stray index with garbage
+    instead of failing loudly)."""
+    g = jnp.take(pages, table, axis=0, mode="clip")      # (B, T, pt, ...)
+    return g.reshape((table.shape[0], -1) + pages.shape[2:])
+
+
+def paged_reference_attention(q: jax.Array, k_pages: jax.Array,
+                              v_pages: jax.Array, table: jax.Array,
+                              lengths, *, scale: Optional[float] = None,
+                              attn_mask=None,
+                              s_kv: Optional[int] = None) -> jax.Array:
+    """Paged decode/prefill attention on the XLA path.
+
+    ``q``: ``(B, S, H, D)`` — the S newest tokens, occupying positions
+    ``[lengths - S, lengths)`` per row; ``lengths``: ``(B,)`` or scalar,
+    valid tokens AFTER the append.  ``s_kv`` slices the gathered view to
+    the model's contiguous cache length so shapes (and therefore streams)
+    match the gather path exactly.  Supports ``attn_mask`` broadcastable
+    to ``(B, 1, S, s_kv)`` like the contiguous jnp path.
+    """
+    from ..attention import _jnp_attention
+
+    B, S, H, D = q.shape
+    KV = k_pages.shape[2]
+    k = gather_kv_pages(k_pages, table)
+    v = gather_kv_pages(v_pages, table)
+    if s_kv is not None and s_kv < k.shape[1]:
+        k = k[:, :s_kv]
+        v = v[:, :s_kv]
+    if KV != H:      # GQA fallback: repeat KV heads for the dense path
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    lengths = jnp.broadcast_to(
+        jnp.asarray(lengths, jnp.int32).reshape(-1), (B,))
+    q_pos = lengths[:, None] - S + jnp.arange(S)[None, :]       # (B, S)
+    k_pos = jnp.arange(k.shape[1])
+    mask = k_pos[None, None, None, :] <= q_pos[:, None, :, None]
+    if attn_mask is not None:
+        mask = jnp.logical_and(mask, attn_mask)
+    return _jnp_attention(q, k, v, causal=False, bias=None, mask=mask,
+                          dropout_rate=0.0, dropout_rng=None, scale=scale)
