@@ -33,33 +33,20 @@ MODEL = "gpt2-125m"
 SEQ = 1024
 REF_MFU = 64.0 / 125.0  # DeepSpeed BERT-Large on V100: published best single-chip
 
-# bf16 peak TFLOPS per chip by TPU generation
-PEAK_TFLOPS = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-               "v6 lite": 918e12, "v6e": 918e12, "cpu": 1e12}
-
-# HBM bandwidth per chip (bytes/s) — the decode bandwidth-floor
-# denominator: a decode tick must stream every weight byte plus the live
-# KV cache, so floor_ms = bytes / BW is the physics bound the serving
-# numbers are judged against (VERDICT round-6 ask)
-HBM_BYTES_S = {"v4": 1228e9, "v5 lite": 819e9, "v5e": 819e9,
-               "v5p": 2765e9, "v6 lite": 1640e9, "v6e": 1640e9,
-               "cpu": 50e9}
-
-
-def _device_lookup(dev, table: dict, default: float) -> float:
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return default
-
-
+# Device physics (peak FLOPs, HBM bytes/s) live in ONE place —
+# telemetry/attribution.py — shared with the live roofline plane
+# (/profilez) and the flops profiler, so the bench and the serving
+# telemetry can never report different physics for the same executable.
 def _peak(dev) -> float:
-    return _device_lookup(dev, PEAK_TFLOPS, 1e12)
+    from deepspeed_tpu.telemetry import attribution
+
+    return attribution.device_peak_flops(dev, default=1e12)
 
 
 def _hbm_bytes_s(dev) -> float:
-    return _device_lookup(dev, HBM_BYTES_S, 50e9)
+    from deepspeed_tpu.telemetry import attribution
+
+    return attribution.device_hbm_bytes_s(dev, default=50e9)
 
 
 def _fence(x):
@@ -239,7 +226,6 @@ def bench_serving():
         # int8-vs-fp margin
         steady = []
         steady_ticks = 64 if on_tpu else 4  # pre-warmed window; slots
-        from deepspeed_tpu.telemetry import memory as telemetry_memory
         from deepspeed_tpu.telemetry import registry as telemetry_registry
 
         g0 = telemetry_registry.counter("serving_gather_pages_total").total()
@@ -260,16 +246,21 @@ def bench_serving():
         # bf16 otherwise — the tied LM head stays full width) plus the
         # slots' KV caches; floor_ms is that traffic at the chip's HBM
         # bandwidth, and floor_frac says how close steady decode runs
-        # to the physics bound (1.0 = bandwidth-bound, done-bar >= 0.5)
+        # to the physics bound (1.0 = bandwidth-bound, done-bar >= 0.5).
+        # The arithmetic lives in telemetry/attribution.py — the SAME
+        # module the live /profilez roofline verdicts read — so bench
+        # and the serving plane cannot disagree on the physics.
         from deepspeed_tpu.models import common as model_common
+        from deepspeed_tpu.telemetry import attribution
 
-        weight_bytes = telemetry_memory.tree_bytes(eng.params)
-        kv_bytes = slots * telemetry_memory.tree_bytes(
-            jax.eval_shape(lambda: eng.init_cache(1)))
+        floor = attribution.decode_stream_floor(
+            eng.params, jax.eval_shape(lambda: eng.init_cache(1)), slots,
+            dev=jax.devices()[0])
+        weight_bytes = floor["weight_stream_bytes"]
+        kv_bytes = floor["kv_stream_bytes_per_tick"]
         steady_med = statistics.median(steady)
         ms_tick = 1000.0 * slots / steady_med if steady_med else 0.0
-        floor_ms = 1000.0 * (weight_bytes + kv_bytes) \
-            / _hbm_bytes_s(jax.devices()[0])
+        floor_ms = floor["bw_floor_ms_per_tick"]
         fused_mode = model_common.decode_fused_mode(eng.decode_cfg)
         paged_on = batcher.paged is not None
         del eng, batcher

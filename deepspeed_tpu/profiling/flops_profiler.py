@@ -23,11 +23,13 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..telemetry import attribution as _attribution
 from ..utils.logging import logger
 
-# bf16 peak flops per chip (same table bench.py uses)
-PEAK_TFLOPS = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-               "v6 lite": 918e12, "v6e": 918e12}
+# bf16 peak flops per chip — THE shared table in
+# telemetry/attribution.py (bench.py and the live roofline plane read
+# the same one); kept under the historical name for callers
+PEAK_TFLOPS = _attribution.PEAK_FLOPS
 
 
 def profile_compiled(fn: Callable, *args, static_argnums=(),
@@ -43,15 +45,11 @@ def profile_compiled(fn: Callable, *args, static_argnums=(),
     if lowered is None:
         lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
     compiled = lowered.compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, list):  # some backends return [dict]
-        costs = costs[0] if costs else {}
-    costs = dict(costs or {})
-    out = {
-        "flops": float(costs.get("flops", 0.0)),
-        "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
-        "transcendentals": float(costs.get("transcendentals", 0.0)),
-    }
+    # the cost normalization is THE shared one (telemetry/attribution.py
+    # harvest_costs) — the profiler, the bench and the live roofline
+    # plane read the compiler's numbers identically
+    out = _attribution.harvest_costs(compiled) or {
+        "flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0}
     # per-device bytes, one normalizer shared with the autotuner and the
     # HBM gauges (telemetry/memory.py) — no private memory_analysis math
     from ..telemetry import memory as telemetry_memory
@@ -151,11 +149,14 @@ def params_profile(params) -> dict:
 def _device_peak_flops() -> Optional[float]:
     import jax
 
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    for key, val in PEAK_TFLOPS.items():
-        if key in kind:
-            return val
-    return None
+    # None for unknown kinds: MFU against a guessed peak is noise.  The
+    # shared table carries a nominal "cpu" entry for the live roofline
+    # plane's verdicts; the profiler's historical behavior (no MFU line
+    # off-TPU) is preserved by excluding it here.
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    return _attribution.device_peak_flops(dev, default=None)
 
 
 class FlopsProfiler:

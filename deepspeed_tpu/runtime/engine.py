@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import re
+import time
 from typing import Any, Callable, Optional
 
 import flax
@@ -38,7 +39,8 @@ from .. import comm
 from ..comm.mesh import DATA_AXES, MeshConfig, build_mesh, data_parallel_size, set_mesh
 from ..models.common import TP_RULES
 from ..parallel import zero as zero_lib
-from ..telemetry import recompile, registry as telemetry_registry, trace
+from ..telemetry import (attribution as telemetry_attribution, recompile,
+                         registry as telemetry_registry, trace)
 from ..utils import ThroughputTimer, log_dist, logger
 from . import precision
 from .config import Config
@@ -1647,10 +1649,29 @@ class Engine:
                 log_dist(f"step={self.global_steps} loss={float(jax.device_get(loss)):.4f} "
                          f"(offload={self.offload_device})", ranks=[0])
             return loss
+        # roofline attribution (telemetry/attribution.py, opt-in via
+        # DSTPU_ATTRIBUTION): 1-in-N steps fence the loss and record the
+        # step's host wall against the train step's AOT-harvested costs
+        # (record_memory_profile publishes them).  Unsampled steps keep
+        # async dispatch — the fence is the whole cost of a sample.
+        attr_sample = telemetry_attribution.enabled() and \
+            telemetry_attribution.should_sample("engine.train_step")
+        attr_sigs0 = getattr(self._compiled_train_step,
+                             "signatures_seen", None) if attr_sample else None
         self._tput.start()
+        t_attr = time.perf_counter() if attr_sample else 0.0
         with trace.span("train/fwd-bwd", step=self.global_steps):
             self._state, metrics = self._compiled_train_step(
                 self._state, batch, *extra)
+        if attr_sample:
+            # compile-paying steps are discarded inside note_window (the
+            # serving windows apply the same discipline); costs come
+            # from record_memory_profile's AOT point, so no lazy-harvest
+            # args are passed
+            jax.block_until_ready(metrics["loss"])
+            telemetry_attribution.note_window(
+                "engine.train_step", time.perf_counter() - t_attr,
+                self._compiled_train_step, attr_sigs0)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         self.global_samples += self.train_batch_size

@@ -40,6 +40,7 @@ from .registry import (  # noqa: F401
 )
 from . import goodput, memory  # noqa: F401  (need registry+trace above)
 from . import exporter, flightrec  # noqa: F401
+from . import anomaly, attribution  # noqa: F401  (need exporter above)
 
 # arm the per-rank exit dump when the launcher asked for one
 maybe_install_exit_dump()
@@ -49,6 +50,11 @@ goodput.install()
 from .registry import register_collector as _register_collector  # noqa: E402
 
 _register_collector(memory.sample_live_hbm)
+# roofline attribution (/profilez, opt-in sampling via
+# DSTPU_ATTRIBUTION) + anomaly/alert detectors (/alertz, evaluated on
+# scrapes and step boundaries)
+attribution.install()
+anomaly.install()
 # crash forensics when a dump dir is configured; live endpoints when a
 # port is configured
 flightrec.maybe_install()

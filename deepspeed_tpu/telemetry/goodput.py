@@ -158,6 +158,14 @@ class GoodputTracker:
             flightrec.mark(kind, context)
         except Exception:
             pass
+        try:
+            # anomaly detectors evaluate at step cadence (throttled to
+            # ~1/s inside observe) — no extra thread, no extra sync
+            from . import anomaly
+
+            anomaly.observe()
+        except Exception:
+            pass
 
     def last_step_age(self) -> Optional[float]:
         """Seconds since the last completed step, None before the first."""
