@@ -39,12 +39,10 @@ from .engine import InferenceEngine, _sample
 from ..utils.logging import logger
 
 # per-output-token latency lands anywhere from tens of MICROseconds
-# (fused+paged decode at 8 slots on real chips — below the old 0.1 ms
-# smallest bucket, which collapsed the p50/p99 the anomaly detectors
-# read) to seconds (CPU-mesh tests); ms-denominated buckets spanning
-# both
-_TPOT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-                 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0)
+# (fused+paged decode at 8 slots on real chips) to seconds (CPU-mesh
+# tests); the schema lives in registry.BUCKET_SCHEMAS so the fleet
+# aggregator can assert one bucket layout per metric family
+_TPOT_BUCKETS = telemetry_registry.TPOT_MS_BUCKETS
 
 
 @dataclasses.dataclass
@@ -185,9 +183,11 @@ class ContinuousBatcher:
         self._m_ticks = telemetry_registry.counter(
             "serving_decode_ticks_total", "decode ticks executed")
         self._m_ttft = telemetry_registry.histogram(
-            "serving_ttft_seconds", "submit -> first token on host")
+            "serving_ttft_seconds", "submit -> first token on host",
+            buckets=telemetry_registry.SECONDS_BUCKETS)
         self._m_e2e = telemetry_registry.histogram(
-            "serving_e2e_seconds", "submit -> retirement")
+            "serving_e2e_seconds", "submit -> retirement",
+            buckets=telemetry_registry.SECONDS_BUCKETS)
         # TPOT (time per output token): decode-window wall time divided
         # by tokens actually emitted in that window — the denominator
         # speculative decoding moves, so its win shows up on /metrics

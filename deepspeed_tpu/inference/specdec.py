@@ -80,10 +80,9 @@ def verify_site(w: int, greedy: bool) -> str:
     way."""
     return f"serving.verify[{w}{'g' if greedy else 's'}]"
 
-# accepted drafts per slot per verify tick land in [0, k]; buckets cover
-# any sane k without re-registering per config
-_ACCEPT_LEN_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
-                       24.0, 32.0)
+# accepted drafts per slot per verify tick land in [0, k]; the schema is
+# declared ONCE in registry.BUCKET_SCHEMAS (fleet bucket-wise merge
+# asserts one layout per family)
 
 
 # ---------------------------------------------------------------------------
@@ -344,7 +343,7 @@ class SpecDecoder:
         self._m_alen = telemetry_registry.histogram(
             "specdec_accepted_len",
             "accepted drafts per active slot per verify tick",
-            buckets=_ACCEPT_LEN_BUCKETS)
+            buckets=telemetry_registry.ACCEPT_LEN_BUCKETS)
         self._m_rate = telemetry_registry.gauge(
             "specdec_acceptance_rate",
             "EWMA of per-verify-tick draft acceptance")

@@ -19,7 +19,9 @@ topology itself.  So the launcher's jobs reduce to:
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import re
 import shlex
 import signal
 import subprocess
@@ -29,6 +31,104 @@ import time
 from typing import Optional
 
 from ..utils.logging import logger
+
+_DISCOVERY_RE = re.compile(r"^telemetry_rank(\d+)\.json$")
+
+
+def _reset_fleet_discovery(metrics_dir: Optional[str]) -> None:
+    """Remove stale per-rank discovery files + ``fleet.json`` from a
+    REUSED metrics dir before launching: a scraper must never route to
+    last run's ports."""
+    if not metrics_dir or not os.path.isdir(metrics_dir):
+        return
+    for fn in os.listdir(metrics_dir):
+        if _DISCOVERY_RE.match(fn) or fn == "fleet.json":
+            try:
+                os.remove(os.path.join(metrics_dir, fn))
+            except OSError:
+                pass
+
+
+def _update_fleet_discovery(metrics_dir: str, state: dict,
+                            num_processes: int) -> None:
+    """Aggregate the workers' ``telemetry_rank<k>.json`` files (written
+    by ``telemetry/exporter.py`` once each rank's exporter BINDS — the
+    only way to learn an OS-assigned ``--telemetry_port 0`` port) into
+    the single ``fleet.json`` the fleet aggregator's file-discovery
+    mode watches.  Rewritten (atomically) only when the replica set
+    actually changes; ``state`` carries the last-written signature
+    across calls."""
+    entries = []
+    try:
+        names = os.listdir(metrics_dir)
+    except OSError:
+        return
+    for fn in names:
+        m = _DISCOVERY_RE.match(fn)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(metrics_dir, fn)) as fh:
+                doc = json.load(fh)
+            entries.append({"rank": int(m.group(1)),
+                            "host": doc["host"], "port": int(doc["port"]),
+                            "pid": doc.get("pid")})
+        except Exception:
+            continue            # torn/partial file: pick it up next pass
+    entries.sort(key=lambda e: e["rank"])
+    sig = tuple((e["rank"], e["host"], e["port"], e["pid"])
+                for e in entries)
+    if sig == state.get("sig"):
+        return
+    state["sig"] = sig
+    path = os.path.join(metrics_dir, "fleet.json")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump({"replicas": entries,
+                       "num_processes": num_processes,
+                       "updated": time.time()}, fh, indent=1)
+        os.replace(tmp, path)
+        logger.info(f"fleet discovery: {len(entries)}/{num_processes} "
+                    f"replica exporter(s) in {path}")
+    except OSError as e:
+        logger.warning(f"could not write fleet discovery file: {e!r}")
+
+
+def _straggler_statusz(metrics_dir: Optional[str],
+                       rank: int) -> Optional[str]:
+    """One best-effort ``/statusz`` fetch for a lagging rank via the
+    discovery file, so a straggler warning says WHAT the rank was doing
+    (deep queue vs wedged loop) — not just that it is slow.  Returns a
+    short annotation or None when no discovery/exporter is available."""
+    if not metrics_dir:
+        return None
+    try:
+        with open(os.path.join(metrics_dir, "fleet.json")) as fh:
+            doc = json.load(fh)
+        entry = next((r for r in doc.get("replicas", [])
+                      if r.get("rank") == rank), None)
+        if entry is None:
+            return None
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{entry['host']}:{entry['port']}/statusz",
+                timeout=0.5) as r:
+            st = json.loads(r.read())
+    except Exception:
+        return "statusz unreachable (exporter not responding)"
+    serving = st.get("serving") or {}
+    goodput = st.get("goodput") or {}
+    bits = ["responsive"]
+    if serving:
+        bits.append(f"queue_depth={serving.get('queued')}"
+                    f"+{serving.get('parked')} parked")
+        bits.append(f"active_slots={serving.get('active_slots')}")
+    ratio = goodput.get("goodput_ratio")
+    if ratio is not None:
+        bits.append(f"goodput={ratio}")
+    return "statusz: " + " ".join(str(b) for b in bits)
 
 
 def parse_hostfile(path: str) -> dict[str, int]:
@@ -196,6 +296,8 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
     loop can tell shutdown from failure."""
     procs = []
     coord = f"{args.master_addr}:{args.coordinator_port}"
+    # per-run discovery files must not survive into a reused metrics dir
+    _reset_fleet_discovery(args.metrics_dir)
     hb_dir = tempfile.mkdtemp(prefix="dstpu_hb_") \
         if args.heartbeat_timeout > 0 else None
     hb_files = []
@@ -231,9 +333,16 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
         if hb_files else None
     age_report_every = max(2.0, args.heartbeat_timeout / 2)
     last_age_report = time.monotonic()
+    fleet_state: dict = {}
+    last_fleet_scan = 0.0
     rc = 0
     try:
         while True:
+            if args.metrics_dir \
+                    and time.monotonic() - last_fleet_scan > 1.0:
+                last_fleet_scan = time.monotonic()
+                _update_fleet_discovery(args.metrics_dir, fleet_state,
+                                        args.num_processes)
             states = [pr.poll() for pr in procs]
             if all(s is not None for s in states):
                 rc = next((s for s in states if s), 0)
@@ -261,11 +370,27 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
                         if states[r] is None and a is not None
                         and a > args.heartbeat_timeout / 2]
                     if lagging:
-                        # a straggler is visible BEFORE it is declared dead
-                        logger.warning(
-                            "heartbeat straggler(s): " + ", ".join(
+                        # a straggler is visible BEFORE it is declared
+                        # dead — and with a discovery file present, the
+                        # warning says what the rank was DOING (one
+                        # best-effort /statusz fetch per lagging rank).
+                        # Fetches are capped at the 4 worst laggards:
+                        # the monitor loop's first duty is failure
+                        # DETECTION, and a fleet-wide wedge must not
+                        # stall it for n_ranks x timeout while every
+                        # exporter times out.
+                        probe = {r for r, _ in sorted(
+                            lagging, key=lambda x: -x[1])[:4]}
+                        parts = []
+                        for r, a in lagging:
+                            ctx = _straggler_statusz(args.metrics_dir,
+                                                     r) \
+                                if r in probe else None
+                            parts.append(
                                 f"rank {r} last beat {a:.1f}s ago"
-                                for r, a in lagging)
+                                + (f" [{ctx}]" if ctx else ""))
+                        logger.warning(
+                            "heartbeat straggler(s): " + ", ".join(parts)
                             + f" (timeout {args.heartbeat_timeout}s)")
             time.sleep(0.2)
         _reap(procs)

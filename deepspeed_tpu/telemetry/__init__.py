@@ -24,6 +24,11 @@ Seven coordinated surfaces replacing the reference's scattered
 - :mod:`.flightrec` — always-on crash flight recorder (last spans /
   logs / metric deltas) dumped on atexit, SIGTERM/SIGABRT, and
   unhandled exceptions; the launcher pretty-prints it on restart.
+- :mod:`.fleet` — the multi-replica rollup: scrapes N per-rank
+  exporters (static list / env / the launcher-written ``fleet.json``),
+  merges them per metric kind, runs a per-replica health state
+  machine, and serves ``/fleetz`` + a federated ``/metrics`` — the
+  ``FleetView`` seam the multi-replica router steers by.
 
 Launcher integration: ``dstpu --metrics_dir DIR`` injects
 ``DSTPU_METRICS_DIR`` so every rank dumps ``metrics_rank<k>.json`` on
@@ -41,6 +46,7 @@ from .registry import (  # noqa: F401
 from . import goodput, memory  # noqa: F401  (need registry+trace above)
 from . import exporter, flightrec  # noqa: F401
 from . import anomaly, attribution  # noqa: F401  (need exporter above)
+from . import fleet  # noqa: F401  (needs registry + anomaly above)
 
 # arm the per-rank exit dump when the launcher asked for one
 maybe_install_exit_dump()

@@ -472,35 +472,66 @@ class AnomalyEngine:
 
     def _record(self, ev: dict) -> None:
         """State mutation only (caller holds the lock): the event ring
-        and the active set."""
+        and the active set.  The active set is keyed by ``ev["key"]``
+        when present (external emitters like the fleet aggregator track
+        one alert PER REPLICA under one rule name) and by the rule
+        otherwise."""
         self.events.append(ev)
         # pulse rules (attribution drift) never stay active
         pulse = any(d.name == ev["rule"]
                     and isinstance(d, AttributionDriftDetector)
                     for d in self.detectors)
+        key = ev.get("key", ev["rule"])
         if ev["state"] == "firing" and not pulse:
-            self._active[ev["rule"]] = ev
+            self._active[key] = ev
         else:
-            self._active.pop(ev["rule"], None)
+            self._active.pop(key, None)
 
     def _emit(self, ev: dict) -> None:
         """Side effects OUTSIDE the engine lock: registry metrics (own
         lock), warning log, subscriber callbacks."""
+        # with keyed (per-replica) alerts, the rule's firing gauge stays
+        # 1 until the LAST active key under that rule clears
+        rule_firing = any(e["rule"] == ev["rule"]
+                          for e in self.active().values())
         if ev["state"] == "firing":
             self._m_alerts.labels(rule=ev["rule"]).inc()
             self._m_firing.labels(rule=ev["rule"]).set(
-                0.0 if ev["rule"] not in self.active() else 1.0)
+                1.0 if rule_firing else 0.0)
             logger.warning(
                 f"ALERT {ev['rule']} firing: value={ev['value']} "
                 f"threshold={ev['threshold']} detail={ev['detail']}")
         else:
-            self._m_firing.labels(rule=ev["rule"]).set(0.0)
+            self._m_firing.labels(rule=ev["rule"]).set(
+                1.0 if rule_firing else 0.0)
             logger.warning(f"ALERT {ev['rule']} cleared")
         for fn in list(self._subs):
             try:
                 fn(ev)
             except Exception:
                 pass          # a subscriber must never break telemetry
+
+    def emit_event(self, rule: str, state: str, *, value=None,
+                   threshold=None, detail: Optional[dict] = None,
+                   key: Optional[str] = None,
+                   now: Optional[float] = None) -> dict:
+        """Record + dispatch an externally-produced alert transition —
+        the seam for state machines that live OUTSIDE the detector loop
+        (the fleet aggregator's replica health transitions).  The event
+        rides the exact machinery detector transitions do:
+        ``alerts_total{rule}`` / ``alerts_firing{rule}``, the event ring
+        + ``/alertz`` active set (keyed by ``key`` so one rule can track
+        N replicas), the warning log, and every subscriber."""
+        ev = {"rule": rule, "state": state,
+              "t": time.time() if now is None else now,
+              "value": value, "threshold": threshold,
+              "detail": detail or {}}
+        if key is not None:
+            ev["key"] = key
+        with self._lock:
+            self._record(ev)
+        self._emit(ev)
+        return ev
 
     # -- the consumer seam ---------------------------------------------
     def subscribe(self, fn: Callable[[dict], None]) -> Callable[[], None]:

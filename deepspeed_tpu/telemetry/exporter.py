@@ -40,8 +40,9 @@ from . import registry as _registry
 
 __all__ = ["TelemetryExporter", "maybe_start", "get_exporter",
            "register_status_provider", "unregister_status_provider",
-           "register_status_owner", "TELEMETRY_PORT_ENV",
-           "TELEMETRY_HOST_ENV", "HEALTHZ_STALE_ENV"]
+           "register_status_owner", "write_discovery",
+           "TELEMETRY_PORT_ENV", "TELEMETRY_HOST_ENV",
+           "HEALTHZ_STALE_ENV"]
 
 TELEMETRY_PORT_ENV = "DSTPU_TELEMETRY_PORT"
 TELEMETRY_HOST_ENV = "DSTPU_TELEMETRY_HOST"
@@ -250,6 +251,35 @@ class TelemetryExporter:
             self._thread = None
 
 
+def write_discovery(ex: "TelemetryExporter", rank: int,
+                    directory: Optional[str] = None) -> Optional[str]:
+    """Publish this rank's BOUND exporter address as
+    ``<dir>/telemetry_rank<k>.json`` (host, port, pid).
+
+    With ``--telemetry_port 0`` (OS-assigned) the actual port is
+    unknowable to any scraper; this file is how the fleet plane learns
+    it — the launcher aggregates every rank's file into the single
+    ``fleet.json`` discovery file ``telemetry/fleet.py`` watches.
+    ``directory`` defaults to ``DSTPU_METRICS_DIR`` (launcher-injected);
+    no directory → no file.  Atomic rename so a mid-write scan never
+    reads a torn JSON; best-effort (returns the path or None)."""
+    directory = directory or os.environ.get(_registry.METRICS_DIR_ENV)
+    if not directory or ex is None or ex.port is None:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"telemetry_rank{rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"rank": rank, "host": ex.host, "port": ex.port,
+                       "pid": os.getpid(), "unix_time": time.time()}, fh)
+        os.replace(tmp, path)
+        return path
+    except Exception as e:   # discovery is best-effort, never fatal
+        logger.warning(f"telemetry: could not write discovery file: {e!r}")
+        return None
+
+
 _START_MONO = time.monotonic()
 _START_WALL = time.time()
 _exporter: Optional[TelemetryExporter] = None
@@ -307,4 +337,8 @@ def maybe_start(port: Optional[int] = None) -> Optional[TelemetryExporter]:
         logger.warning(f"telemetry exporter failed to bind port {bound}: "
                        f"{e}; continuing without one")
         _exporter = None
+    if _exporter is not None:
+        # fleet discovery: publish the BOUND port (essential with
+        # port 0) where the launcher's fleet.json aggregation reads it
+        write_discovery(_exporter, rank)
     return _exporter

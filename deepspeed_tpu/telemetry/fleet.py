@@ -1,0 +1,1094 @@
+"""Fleet telemetry plane: multi-replica scrape/merge + replica health.
+
+Every observability surface so far sees exactly ONE process: per-rank
+``/metrics`` / ``/healthz`` / ``/statusz`` / ``/alertz`` exporters
+(``telemetry/exporter.py``) with no consumer that can see two of them at
+once.  The multi-replica serving plane (ROADMAP item 2 — prefix-cache-
+aware routing, SLO-aware admission) is steered by telemetry, so the
+fleet-level view is its load-bearing prerequisite.  This module is that
+view, mirroring how Prometheus federation separates the per-replica
+scrape surface from the cluster rollup schedulers consume:
+
+- :func:`parse_prometheus` — the inverse of
+  ``registry.render_prometheus()``: text → a ``snapshot()``-shaped
+  structure.  ``registry.render_prometheus_snapshot(parse_prometheus(t))
+  == t`` byte-for-byte (both directions share one renderer).
+- :func:`merge_metrics` — merge semantics per metric kind: counters
+  SUM across replicas, gauges are kept per-replica with min/max/sum
+  rollups (summing a utilization gauge is a lie), histograms merge
+  bucket-wise — guarded by a mismatched-bucket-schema check (a family
+  whose ``le`` layout differs across replicas is skipped and reported,
+  never silently mis-merged; see ``registry.BUCKET_SCHEMAS``).
+- :func:`federate_metrics` — every replica's samples re-labeled with
+  ``replica=<name>`` into one render-ready structure (the aggregator's
+  federated ``/metrics``).
+- :class:`ReplicaHealth` — a per-replica hysteresis state machine
+  (``healthy``/``degraded``/``stale``/``down``; the ``anomaly.py``
+  fire_after/clear_after pattern).  Scrape failures and ``/healthz``
+  staleness are the inputs; transitions set
+  ``fleet_replica_state{replica,state}`` and entering/leaving ``down``
+  rides the alert machinery (``anomaly.emit_event`` →
+  ``alerts_total{rule="fleet_replica_down"}``, ``/alertz``,
+  subscribers) — exactly once per outage, not once per scrape.
+- :class:`FleetView` — discovery (static ``host:port`` list,
+  ``DSTPU_FLEET_REPLICAS`` env, or the ``fleet.json`` discovery file
+  the launcher writes), a background scrape loop over the four
+  endpoints, the merged rollup, and the programmatic seam the item-2
+  router/admission controller will consume: ``replicas()``,
+  ``healthy()``, ``best_for_prefix()``, ``total_queue_depth()``.
+- :class:`FleetServer` — serves ``/fleetz`` (per-replica table +
+  fleet rollups) and the federated ``/metrics``.
+
+Stdlib-only (urllib + the registry): the aggregator runs standalone
+(``scripts/fleetz.py``) without touching jax.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+from . import registry as _registry
+
+__all__ = [
+    "parse_prometheus", "merge_metrics", "federate_metrics",
+    "histogram_quantile", "family_histogram", "metric_total",
+    "ReplicaHealth", "HEALTH_STATES", "FleetView", "FleetServer",
+    "resolve_targets", "read_discovery", "FLEET_REPLICAS_ENV",
+    "DISCOVERY_FILENAME",
+]
+
+FLEET_REPLICAS_ENV = "DSTPU_FLEET_REPLICAS"
+DISCOVERY_FILENAME = "fleet.json"
+
+# ---------------------------------------------------------------------------
+# Prometheus text parsing — the inverse of registry.render_prometheus()
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)")
+_UNESCAPE = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _parse_labels(s: str) -> Dict[str, str]:
+    """``k="v",k2="v2"`` (the brace interior) → ordered dict, undoing
+    ``registry._escape_label_value`` (``\\\\``, ``\\"``, ``\\n``)."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        j = s.index("=", i)
+        name = s[i:j].strip()
+        if j + 1 >= n or s[j + 1] != '"':
+            raise ValueError(f"malformed label in {s!r}")
+        i = j + 2
+        out: List[str] = []
+        while s[i] != '"':
+            if s[i] == "\\" and i + 1 < n:
+                out.append(_UNESCAPE.get(s[i + 1], s[i + 1]))
+                i += 2
+            else:
+                out.append(s[i])
+                i += 1
+        i += 1                                   # closing quote
+        labels[name] = "".join(out)
+        if i < n and s[i] == ",":
+            i += 1
+    return labels
+
+
+def _split_sample(line: str) -> Tuple[str, Dict[str, str], str]:
+    """One exposition sample line → (name, labels, value-string).  The
+    label scan is quote-aware: values may contain ``}``/`` ``/``,``."""
+    m = _NAME_RE.match(line)
+    if not m:
+        raise ValueError(f"malformed sample line: {line!r}")
+    name = m.group(1)
+    rest = line[m.end():]
+    labels: Dict[str, str] = {}
+    if rest.startswith("{"):
+        i, depth_q = 1, False
+        while i < len(rest):
+            c = rest[i]
+            if c == "\\" and depth_q:
+                i += 2
+                continue
+            if c == '"':
+                depth_q = not depth_q
+            elif c == "}" and not depth_q:
+                break
+            i += 1
+        if i >= len(rest):
+            raise ValueError(f"unterminated labels: {line!r}")
+        labels = _parse_labels(rest[1:i])
+        rest = rest[i + 1:]
+    return name, labels, rest.strip()
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return float("inf")
+    if s == "-Inf":
+        return float("-inf")
+    return float(s)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text (v0.0.4, as ``registry.render_prometheus()``
+    emits it) → a ``Registry.snapshot()``-shaped dict: ``{name:
+    {"type", "help", "labelnames", "samples": [...]}}``.
+
+    Round-trip contract:
+    ``registry.render_prometheus_snapshot(parse_prometheus(t)) == t``
+    byte-for-byte for any ``t`` the renderer produced — metric and
+    sample order, label order, bucket order, escaping and number
+    formatting all survive.  Histogram ``le`` keys are kept as their
+    rendered STRINGS (``"0.5"``, ``"+Inf"``): they are dict keys on
+    both sides, so no float round-trip can perturb them."""
+    out: Dict[str, dict] = {}
+    helps: Dict[str, str] = {}
+    # histogram samples grouped by (family, base-labels-minus-le)
+    hist_rows: Dict[Tuple[str, tuple], dict] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_ = line[len("# HELP "):].partition(" ")
+            helps[name] = help_
+            continue
+        if line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            out[name] = {"type": kind.strip(), "help": helps.get(name, ""),
+                         "labelnames": [], "samples": []}
+            continue
+        if line.startswith("#"):
+            continue
+        name, labels, value_s = _split_sample(line)
+        base = None
+        if name in out and out[name]["type"] != "histogram":
+            base = name
+        else:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    cand = name[:-len(suffix)]
+                    if cand in out and out[cand]["type"] == "histogram":
+                        base, name = cand, name
+                        break
+            if base is None and name in out:
+                base = name                     # histogram-typed bare name
+        if base is None:
+            # sample with no TYPE line — tolerate (foreign exposition),
+            # default to untyped gauge-like entry
+            out[name] = {"type": "gauge", "help": helps.get(name, ""),
+                         "labelnames": [], "samples": []}
+            base = name
+        entry = out[base]
+        if entry["type"] == "histogram":
+            key_labels = {k: v for k, v in labels.items() if k != "le"}
+            key = (base, tuple(key_labels.items()))
+            row = hist_rows.get(key)
+            if row is None:
+                row = {"labels": key_labels, "buckets": {},
+                       "sum": 0.0, "count": 0}
+                hist_rows[key] = row
+                entry["samples"].append(row)
+                if not entry["labelnames"]:
+                    entry["labelnames"] = list(key_labels)
+            if name.endswith("_bucket"):
+                row["buckets"][labels.get("le", "+Inf")] = \
+                    int(float(value_s))
+            elif name.endswith("_sum"):
+                row["sum"] = _parse_value(value_s)
+            elif name.endswith("_count"):
+                row["count"] = int(float(value_s))
+        else:
+            entry["samples"].append(
+                {"labels": labels, "value": _parse_value(value_s)})
+            if not entry["labelnames"]:
+                entry["labelnames"] = list(labels)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merge semantics per metric kind
+# ---------------------------------------------------------------------------
+
+def metric_total(parsed: Optional[dict], name: str) -> Optional[float]:
+    """Sum of one family's sample values (counter/gauge) in a parsed
+    scrape; None when absent."""
+    if not parsed or name not in parsed:
+        return None
+    entry = parsed[name]
+    if entry["type"] == "histogram":
+        return float(sum(s.get("count", 0) for s in entry["samples"]))
+    return float(sum(s.get("value", 0.0) for s in entry["samples"]))
+
+
+def merge_metrics(per_replica: Dict[str, dict]
+                  ) -> Tuple[dict, List[dict]]:
+    """Merge N parsed scrapes into one fleet rollup.
+
+    Per kind: **counters** sum per labelset; **gauges** keep per-replica
+    values with ``min``/``max``/``sum`` rollups per labelset (a mean or
+    sum alone would hide the straggler the fleet view exists to show);
+    **histograms** merge bucket-wise (cumulative ``le`` counts add
+    exactly — the registry's fixed-bucket design is WHY).  A histogram
+    family whose bucket schema differs across replicas (``le`` key
+    tuples unequal) is dropped from the merge and reported in the
+    returned ``issues`` list; same for a family registered under
+    different types.  Returns ``(merged, issues)``."""
+    merged: Dict[str, dict] = {}
+    issues: List[dict] = []
+    skipped: set = set()
+    for rep, parsed in per_replica.items():
+        if not parsed:
+            continue
+        for name, entry in parsed.items():
+            if name in skipped:
+                continue
+            cur = merged.get(name)
+            if cur is None:
+                cur = merged[name] = {
+                    "type": entry["type"], "help": entry["help"],
+                    "labelnames": list(entry["labelnames"]),
+                    "samples": {}}
+            elif cur["type"] != entry["type"]:
+                issues.append({"metric": name, "kind": "type_conflict",
+                               "replica": rep,
+                               "detail": f"{cur['type']} vs "
+                                         f"{entry['type']}"})
+                skipped.add(name)
+                del merged[name]
+                continue
+            if entry["type"] == "histogram":
+                conflict = False
+                for s in entry["samples"]:
+                    schema = tuple(s["buckets"])
+                    key = tuple(s["labels"].items())
+                    dst = cur["samples"].get(key)
+                    if dst is None:
+                        cur["samples"][key] = {
+                            "labels": dict(s["labels"]),
+                            "buckets": dict(s["buckets"]),
+                            "sum": float(s["sum"]),
+                            "count": int(s["count"])}
+                    elif tuple(dst["buckets"]) != schema:
+                        issues.append({
+                            "metric": name, "kind": "bucket_schema",
+                            "replica": rep,
+                            "detail": f"{list(dst['buckets'])} vs "
+                                      f"{list(schema)}"})
+                        conflict = True
+                        break
+                    else:
+                        for le, c in s["buckets"].items():
+                            dst["buckets"][le] += c
+                        dst["sum"] += float(s["sum"])
+                        dst["count"] += int(s["count"])
+                if conflict:
+                    skipped.add(name)
+                    del merged[name]
+            elif entry["type"] == "counter":
+                for s in entry["samples"]:
+                    key = tuple(s["labels"].items())
+                    dst = cur["samples"].setdefault(
+                        key, {"labels": dict(s["labels"]), "value": 0.0})
+                    dst["value"] += float(s["value"])
+            else:                                # gauge / untyped
+                for s in entry["samples"]:
+                    key = tuple(s["labels"].items())
+                    dst = cur["samples"].setdefault(
+                        key, {"labels": dict(s["labels"]),
+                              "by_replica": {}, "min": None, "max": None,
+                              "sum": 0.0})
+                    v = float(s["value"])
+                    dst["by_replica"][rep] = v
+                    dst["min"] = v if dst["min"] is None \
+                        else min(dst["min"], v)
+                    dst["max"] = v if dst["max"] is None \
+                        else max(dst["max"], v)
+                    dst["sum"] += v
+    # samples dicts → lists (JSON-able, order-stable)
+    for entry in merged.values():
+        entry["samples"] = list(entry["samples"].values())
+    return merged, issues
+
+
+def federate_metrics(per_replica: Dict[str, dict]
+                     ) -> Tuple[dict, List[dict]]:
+    """Union of every replica's families with a ``replica=<name>`` label
+    injected FIRST on each sample — render-ready for the aggregator's
+    federated ``/metrics`` (``registry.render_prometheus_snapshot``).
+    Type conflicts across replicas drop the later replica's family (and
+    land in ``issues``); bucket schemas may legitimately differ here —
+    each sample keeps its own buckets, label-disambiguated."""
+    out: Dict[str, dict] = {}
+    issues: List[dict] = []
+    for rep, parsed in per_replica.items():
+        if not parsed:
+            continue
+        for name, entry in parsed.items():
+            cur = out.get(name)
+            if cur is None:
+                cur = out[name] = {
+                    "type": entry["type"], "help": entry["help"],
+                    "labelnames": ["replica"] + list(entry["labelnames"]),
+                    "samples": []}
+            elif cur["type"] != entry["type"]:
+                issues.append({"metric": name, "kind": "type_conflict",
+                               "replica": rep,
+                               "detail": f"{cur['type']} vs "
+                                         f"{entry['type']}"})
+                continue
+            for s in entry["samples"]:
+                labels = {"replica": rep, **s["labels"]}
+                fs = dict(s)
+                fs["labels"] = labels
+                cur["samples"].append(fs)
+    return out, issues
+
+
+def histogram_quantile(sample: dict, q: float) -> Optional[float]:
+    """Nearest-rank quantile over a cumulative-bucket histogram sample,
+    using THE ``registry.pct`` convention (index ``min(count-1,
+    int(q*count))`` over the sorted observations): returns the upper
+    bound (``le``) of the bucket holding that observation.  None on an
+    empty histogram or when the rank lands in ``+Inf``."""
+    count = int(sample.get("count", 0))
+    if count <= 0:
+        return None
+    idx = min(count - 1, int(q * count))
+    for le_s, cum in sample["buckets"].items():
+        if cum > idx:
+            if le_s == "+Inf":
+                return None
+            return float(le_s)
+    return None
+
+
+def family_histogram(entry: Optional[dict]) -> Optional[dict]:
+    """Collapse a (merged) histogram family's labelsets into one
+    cumulative-bucket sample — safe because the merge guard already
+    enforced a single bucket schema per family."""
+    if not entry or entry.get("type") != "histogram" \
+            or not entry["samples"]:
+        return None
+    first = entry["samples"][0]
+    acc = {"labels": {}, "buckets": dict(first["buckets"]),
+           "sum": float(first["sum"]), "count": int(first["count"])}
+    for s in entry["samples"][1:]:
+        if tuple(s["buckets"]) != tuple(acc["buckets"]):
+            return None
+        for le, c in s["buckets"].items():
+            acc["buckets"][le] += c
+        acc["sum"] += float(s["sum"])
+        acc["count"] += int(s["count"])
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# replica health state machine
+# ---------------------------------------------------------------------------
+
+HEALTH_STATES = ("healthy", "degraded", "stale", "down")
+
+
+class ReplicaHealth:
+    """Hysteresis state machine over one replica's scrape outcomes (the
+    ``anomaly.py`` fire_after/clear_after pattern, per replica).
+
+    Inputs per scrape round: did the ``/metrics`` fetch succeed, and did
+    ``/healthz`` report ok (None = endpoint unavailable, treated as
+    neutral).  States:
+
+    - ``healthy`` — scrapes succeed, ``/healthz`` ok.
+    - ``degraded`` — scrapes succeed but ``/healthz`` reports not-ok
+      (heartbeat/step staleness: the worker process is alive but its
+      loop is wedged) for ``degrade_after`` consecutive rounds.
+    - ``stale`` — ``stale_after`` consecutive scrape failures: no fresh
+      data, not yet presumed dead.  Also the INITIAL state (an
+      undiscovered replica has no fresh data by definition).
+    - ``down`` — ``down_after`` consecutive scrape failures.  Entering
+      fires exactly ONE ``fleet_replica_down`` alert; leaving clears it.
+
+    Flap suppression: recovery from ``stale``/``down`` needs
+    ``clear_after`` consecutive successful scrapes (first contact after
+    discovery needs just one — nothing to suppress yet), and any
+    success resets the failure streak, so alternating fail/ok neither
+    fires nor clears anything."""
+
+    def __init__(self, stale_after: int = 2, down_after: int = 5,
+                 degrade_after: int = 2, clear_after: int = 2):
+        if not (0 < stale_after <= down_after):
+            raise ValueError("need 0 < stale_after <= down_after")
+        self.stale_after = stale_after
+        self.down_after = down_after
+        self.degrade_after = degrade_after
+        self.clear_after = clear_after
+        self.state = "stale"
+        self._ever_ok = False
+        self._fails = 0
+        self._oks = 0
+        self._bad_health = 0
+        self._good_health = 0
+
+    def observe(self, scrape_ok: bool,
+                healthz_ok: Optional[bool] = None
+                ) -> Optional[Tuple[str, str]]:
+        """Fold one scrape round in; returns ``(old, new)`` on a state
+        transition, None otherwise."""
+        old = self.state
+        if not scrape_ok:
+            self._fails += 1
+            self._oks = 0
+            if self._fails >= self.down_after:
+                self.state = "down"
+            elif self._fails >= self.stale_after and old != "down":
+                self.state = "stale"
+        else:
+            first_contact = not self._ever_ok
+            self._fails = 0
+            self._oks += 1
+            self._ever_ok = True
+            if healthz_ok is False:
+                self._bad_health += 1
+                self._good_health = 0
+            else:
+                self._good_health += 1
+                self._bad_health = 0
+            if old in ("stale", "down"):
+                need = 1 if first_contact else self.clear_after
+                if self._oks >= need:
+                    self.state = "degraded" if self._bad_health > 0 \
+                        else "healthy"
+            elif old == "healthy":
+                if self._bad_health >= self.degrade_after:
+                    self.state = "degraded"
+            elif old == "degraded":
+                if self._good_health >= self.clear_after:
+                    self.state = "healthy"
+        return (old, self.state) if self.state != old else None
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+
+def read_discovery(path: str) -> List[dict]:
+    """Parse the launcher-written ``fleet.json``: ``{"replicas":
+    [{"rank", "host", "port", ...}, ...]}`` → the replica entry list
+    (sorted by rank).  Raises on unreadable/malformed files — the
+    caller decides whether absence is an error (CLI) or a wait state
+    (the watch loop)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    reps = doc.get("replicas")
+    if not isinstance(reps, list):
+        raise ValueError(f"{path}: no 'replicas' list")
+    out = []
+    for r in reps:
+        if "host" not in r or "port" not in r:
+            raise ValueError(f"{path}: replica entry missing host/port: "
+                             f"{r!r}")
+        out.append(dict(r))
+    out.sort(key=lambda r: (r.get("rank", 1 << 30), r["host"],
+                            int(r["port"])))
+    return out
+
+
+def resolve_targets(targets: Optional[Sequence[str]] = None,
+                    discovery_file: Optional[str] = None
+                    ) -> Dict[str, str]:
+    """Resolve ``{name: host:port}`` from (in precedence order) an
+    explicit target list, a discovery file, or the
+    ``DSTPU_FLEET_REPLICAS`` env (comma-separated ``host:port``).
+    Static targets are named by their target string; discovered ones
+    ``rank<k>``."""
+    if targets:
+        return {str(t): str(t) for t in targets}
+    if discovery_file:
+        entries = read_discovery(discovery_file)
+        return {f"rank{r.get('rank', i)}": f"{r['host']}:{r['port']}"
+                for i, r in enumerate(entries)}
+    env = os.environ.get(FLEET_REPLICAS_ENV, "")
+    if env.strip():
+        return {t.strip(): t.strip() for t in env.split(",") if t.strip()}
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# the aggregator
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    """One row of the ``/fleetz`` per-replica table — the read-only
+    snapshot ``FleetView.replicas()`` hands the router."""
+    name: str
+    target: str
+    state: str
+    scrapes: int
+    failures: int
+    last_scrape_age_s: Optional[float]
+    queue_depth: Optional[float]
+    active_slots: Optional[float]
+    prefix_hit_rate: Optional[float]
+    goodput_ratio: Optional[float]
+    ttft_p99_ms: Optional[float]
+    tpot_p99_ms: Optional[float]
+    active_alerts: List[str]
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Rep:
+    """Aggregator-internal per-replica state: scrape results + health."""
+
+    def __init__(self, name: str, target: str, health: ReplicaHealth):
+        self.name = name
+        self.target = target
+        self.health = health
+        self.metrics: Optional[dict] = None      # last GOOD parse
+        self.statusz: Optional[dict] = None
+        self.healthz: Optional[dict] = None
+        self.alertz: Optional[dict] = None
+        self.scrapes = 0
+        self.failures = 0
+        self.last_ok_mono: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+    def counter_total(self, name: str) -> float:
+        v = metric_total(self.metrics, name)
+        return 0.0 if v is None else v
+
+
+class FleetView:
+    """Scrapes N replica exporters and merges them into one fleet view.
+
+    The programmatic surface (``replicas()`` / ``healthy()`` /
+    ``best_for_prefix()`` / ``total_queue_depth()``) is the explicit
+    seam the multi-replica router and admission controller consume —
+    the fleet analog of ``anomaly.subscribe()``.
+
+    Discovery: pass ``targets`` (static), ``discovery_file`` (the
+    launcher-written ``fleet.json``, re-read when its mtime moves, so a
+    restarted worker's new OS-assigned port is picked up mid-flight),
+    or neither (``DSTPU_FLEET_REPLICAS`` env).  ``scrape_once()`` runs
+    one synchronous round; ``start()`` runs rounds on a daemon thread.
+    """
+
+    def __init__(self, targets: Optional[Sequence[str]] = None, *,
+                 discovery_file: Optional[str] = None,
+                 interval_s: float = 2.0, timeout_s: float = 2.0,
+                 registry: Optional[_registry.Registry] = None,
+                 anomaly_engine=None,
+                 health_knobs: Optional[dict] = None):
+        self._static_targets = list(targets) if targets else None
+        self.discovery_file = discovery_file
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.registry = registry or _registry.get_registry()
+        self._anomaly = anomaly_engine
+        self._health_knobs = dict(health_knobs or {})
+        self._lock = threading.RLock()
+        self._reps: Dict[str, _Rep] = {}
+        self._discovery_mtime: Optional[float] = None
+        self._rounds = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = self.registry
+        self._m_replicas = reg.gauge(
+            "fleet_replicas", "replicas known to the fleet aggregator")
+        self._m_state = reg.gauge(
+            "fleet_replica_state",
+            "1 for the replica's current health state "
+            "(healthy/degraded/stale/down), 0 otherwise",
+            labelnames=("replica", "state"))
+        self._m_scrapes = reg.counter(
+            "fleet_scrapes_total", "successful replica /metrics scrapes",
+            labelnames=("replica",))
+        self._m_failures = reg.counter(
+            "fleet_scrape_failures_total", "failed replica scrapes",
+            labelnames=("replica",))
+        self._m_schema_conflicts = reg.counter(
+            "fleet_bucket_schema_conflicts_total",
+            "histogram families dropped from a merge evaluation because "
+            "bucket schemas differed across replicas (counted per "
+            "evaluation: a nonzero rate = ongoing schema skew)")
+        self._m_scrape_ms = reg.histogram(
+            "fleet_scrape_ms", "per-replica scrape round-trip",
+            labelnames=("replica",), buckets=_registry.MS_BUCKETS)
+        self._m_queue = reg.gauge(
+            "fleet_total_queue_depth",
+            "summed queue depth across non-down replicas")
+        self._refresh_targets(force=True)
+
+    # -- discovery ------------------------------------------------------
+    def _refresh_targets(self, force: bool = False) -> None:
+        if self._static_targets is not None:
+            mapping = {t: t for t in self._static_targets}
+        elif self.discovery_file:
+            try:
+                mtime = os.path.getmtime(self.discovery_file)
+            except OSError:
+                return                       # not written yet: keep known
+            if not force and mtime == self._discovery_mtime:
+                return
+            try:
+                entries = read_discovery(self.discovery_file)
+            except Exception as e:
+                logger.warning(f"fleet: unreadable discovery file "
+                               f"{self.discovery_file}: {e!r}")
+                return
+            self._discovery_mtime = mtime
+            mapping = {f"rank{r.get('rank', i)}": f"{r['host']}:{r['port']}"
+                       for i, r in enumerate(entries)}
+        else:
+            mapping = resolve_targets()
+        with self._lock:
+            for name, target in mapping.items():
+                rep = self._reps.get(name)
+                if rep is None:
+                    self._reps[name] = _Rep(
+                        name, target, ReplicaHealth(**self._health_knobs))
+                    self._set_state_gauge(name, "stale")
+                elif rep.target != target:
+                    # a restarted worker came back on a new port: fresh
+                    # scrape history, fresh health machine
+                    logger.info(f"fleet: replica {name} moved "
+                                f"{rep.target} -> {target}")
+                    self._clear_down_alert(rep)
+                    self._reps[name] = _Rep(
+                        name, target, ReplicaHealth(**self._health_knobs))
+                    self._set_state_gauge(name, "stale")
+            for name in [n for n in self._reps if n not in mapping]:
+                self._clear_down_alert(self._reps[name])
+                # zero the state series: the registry has no labelset
+                # removal, and a 1.0 left behind would report the
+                # removed replica's last state forever
+                for s in HEALTH_STATES:
+                    self._m_state.labels(replica=name, state=s).set(0.0)
+                del self._reps[name]
+            self._m_replicas.set(float(len(self._reps)))
+
+    # -- scraping -------------------------------------------------------
+    def _fetch(self, target: str, path: str) -> Tuple[int, bytes]:
+        """GET ``http://target{path}``; returns (status, body).  An HTTP
+        error status (the /healthz 503) is a RESPONSE, not a failure —
+        only transport errors raise.  Override/monkeypatch point for
+        socket-free tests."""
+        try:
+            with urllib.request.urlopen(f"http://{target}{path}",
+                                        timeout=self.timeout_s) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _scrape_replica(self, rep: _Rep) -> bool:
+        t0 = time.monotonic()
+        ok = False
+        healthz_ok: Optional[bool] = None
+        try:
+            code, body = self._fetch(rep.target, "/metrics")
+            if code == 200:
+                rep.metrics = parse_prometheus(body.decode())
+                ok = True
+                rep.last_error = None
+            else:
+                rep.last_error = f"/metrics HTTP {code}"
+        except Exception as e:
+            rep.last_error = repr(e)
+        if ok:
+            rep.last_ok_mono = time.monotonic()
+            rep.scrapes += 1
+            self._m_scrapes.labels(replica=rep.name).inc()
+            self._m_scrape_ms.labels(replica=rep.name).observe(
+                (time.monotonic() - t0) * 1e3)
+            for path, attr in (("/statusz", "statusz"),
+                               ("/healthz", "healthz"),
+                               ("/alertz", "alertz")):
+                try:
+                    _, body = self._fetch(rep.target, path)
+                    setattr(rep, attr, json.loads(body.decode()))
+                except Exception:
+                    setattr(rep, attr, None)
+            if rep.healthz is not None:
+                healthz_ok = bool(rep.healthz.get("ok", True))
+        else:
+            rep.failures += 1
+            self._m_failures.labels(replica=rep.name).inc()
+        transition = rep.health.observe(ok, healthz_ok)
+        if transition is not None:
+            self._on_transition(rep, *transition)
+        return ok
+
+    def _set_state_gauge(self, name: str, state: str) -> None:
+        for s in HEALTH_STATES:
+            self._m_state.labels(replica=name, state=s).set(
+                1.0 if s == state else 0.0)
+
+    def _alert_engine(self):
+        if self._anomaly is not None:
+            return self._anomaly
+        from . import anomaly as _anomaly
+
+        return _anomaly.get_engine()
+
+    def _on_transition(self, rep: _Rep, old: str, new: str) -> None:
+        # degradations warn (and so ride the flight-recorder log ring);
+        # recoveries and first contact just inform
+        log = logger.warning if HEALTH_STATES.index(new) > \
+            HEALTH_STATES.index(old) else logger.info
+        log(f"fleet: replica {rep.name} ({rep.target}) {old} -> {new}")
+        self._set_state_gauge(rep.name, new)
+        try:
+            if new == "down":
+                self._alert_engine().emit_event(
+                    "fleet_replica_down", "firing",
+                    key=f"fleet_replica_down[{rep.name}]",
+                    detail={"replica": rep.name, "target": rep.target,
+                            "from": old, "last_error": rep.last_error})
+            elif old == "down":
+                self._alert_engine().emit_event(
+                    "fleet_replica_down", "cleared",
+                    key=f"fleet_replica_down[{rep.name}]",
+                    detail={"replica": rep.name, "target": rep.target,
+                            "to": new})
+        except Exception as e:      # alerting must never break scraping
+            logger.warning(f"fleet: alert dispatch failed: {e!r}")
+
+    def _clear_down_alert(self, rep: _Rep) -> None:
+        if rep.health.state == "down":
+            try:
+                self._alert_engine().emit_event(
+                    "fleet_replica_down", "cleared",
+                    key=f"fleet_replica_down[{rep.name}]",
+                    detail={"replica": rep.name, "target": rep.target,
+                            "to": "removed"})
+            except Exception:
+                pass
+
+    def scrape_once(self) -> dict:
+        """One scrape round over every known replica; returns
+        ``{name: scrape_ok}``.  Replicas are scraped CONCURRENTLY (a
+        small thread pool): one blackholed host costing a full
+        ``timeout_s`` must not age every other replica's data past the
+        scrape interval — per-replica state is owned by its scrape, and
+        the registry/alert sinks are thread-safe."""
+        self._refresh_targets()
+        with self._lock:
+            reps = list(self._reps.values())
+        if len(reps) <= 1:
+            results = {rep.name: self._scrape_replica(rep)
+                       for rep in reps}
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(reps)),
+                    thread_name_prefix="dstpu-fleet") as pool:
+                futs = {rep.name: pool.submit(self._scrape_replica, rep)
+                        for rep in reps}
+                results = {name: f.result() for name, f in futs.items()}
+        with self._lock:
+            self._rounds += 1
+            self._m_queue.set(self._total_queue_locked())
+        return results
+
+    def start(self) -> "FleetView":
+        """Run scrape rounds on a daemon thread every ``interval_s``."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.scrape_once()
+                except Exception as e:   # the loop must survive anything
+                    logger.warning(f"fleet: scrape round failed: {e!r}")
+        self._thread = threading.Thread(
+            target=loop, name="dstpu-fleet-scrape", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s + self.interval_s)
+            self._thread = None
+
+    # -- the consumer seam (router / admission controller) -------------
+    def _replica_info(self, rep: _Rep) -> ReplicaInfo:
+        serving = (rep.statusz or {}).get("serving") or {}
+        hit = metric_total(rep.metrics, "prefix_cache_hit_tokens_total")
+        miss = metric_total(rep.metrics, "prefix_cache_miss_tokens_total")
+        hit_rate = None
+        if hit is not None and miss is not None and hit + miss > 0:
+            hit_rate = hit / (hit + miss)
+        ttft = serving.get("ttft_p99_ms")
+        if ttft is None and rep.metrics is not None:
+            h = family_histogram(rep.metrics.get("serving_ttft_seconds"))
+            if h is not None:
+                q = histogram_quantile(h, 0.99)
+                ttft = None if q is None else q * 1e3
+        tpot = serving.get("tpot_p99_ms")
+        if tpot is None and rep.metrics is not None:
+            h = family_histogram(rep.metrics.get("serving_tpot_ms"))
+            if h is not None:
+                tpot = histogram_quantile(h, 0.99)
+        alerts = sorted({a.get("rule", "?")
+                         for a in (rep.alertz or {}).get("active", [])})
+        age = None if rep.last_ok_mono is None \
+            else round(time.monotonic() - rep.last_ok_mono, 3)
+        return ReplicaInfo(
+            name=rep.name, target=rep.target, state=rep.health.state,
+            scrapes=rep.scrapes, failures=rep.failures,
+            last_scrape_age_s=age,
+            queue_depth=metric_total(rep.metrics, "serving_queue_depth"),
+            active_slots=metric_total(rep.metrics, "serving_active_slots"),
+            prefix_hit_rate=hit_rate,
+            goodput_ratio=metric_total(rep.metrics, "goodput_ratio"),
+            ttft_p99_ms=ttft, tpot_p99_ms=tpot, active_alerts=alerts)
+
+    def replicas(self) -> List[ReplicaInfo]:
+        with self._lock:
+            return [self._replica_info(r) for r in self._reps.values()]
+
+    def healthy(self) -> List[ReplicaInfo]:
+        return [r for r in self.replicas() if r.state == "healthy"]
+
+    def best_for_prefix(self, counters: Sequence[str] = (
+            "prefix_cache_hit_tokens_total",)) -> Optional[ReplicaInfo]:
+        """The replica a prefix-cache-aware router should prefer: the
+        routable (healthy/degraded) replica with the highest sum of the
+        named hit counters — the ``kvreuse`` counters make cache
+        residency measurable without shipping radix-tree contents.
+        Ties break toward the shallower queue."""
+        with self._lock:
+            cands = [r for r in self._reps.values()
+                     if r.health.state in ("healthy", "degraded")]
+            if not cands:
+                return None
+            best = max(
+                cands,
+                key=lambda r: (
+                    sum(r.counter_total(c) for c in counters),
+                    -(metric_total(r.metrics, "serving_queue_depth")
+                      or 0.0)))
+            return self._replica_info(best)
+
+    def _total_queue_locked(self) -> float:
+        return sum(
+            metric_total(r.metrics, "serving_queue_depth") or 0.0
+            for r in self._reps.values() if r.health.state != "down")
+
+    def total_queue_depth(self) -> float:
+        """Summed queue depth across non-down replicas (a down
+        replica's last-known depth is not real backlog a router can
+        drain)."""
+        with self._lock:
+            return self._total_queue_locked()
+
+    # -- merged views ---------------------------------------------------
+    def _per_replica_metrics(self) -> Dict[str, dict]:
+        with self._lock:
+            return {r.name: r.metrics for r in self._reps.values()
+                    if r.metrics is not None}
+
+    def merged(self) -> Tuple[dict, List[dict]]:
+        merged, issues = merge_metrics(self._per_replica_metrics())
+        fresh = [i for i in issues if i["kind"] == "bucket_schema"]
+        if fresh:
+            self._m_schema_conflicts.inc(len(fresh))
+        return merged, issues
+
+    def federated_prometheus(self) -> str:
+        """The aggregator's ``/metrics`` body: its OWN registry (the
+        ``fleet_*`` plane) and every replica's families with ``replica``
+        labels, merged FAMILY-WISE — a name living in both (the
+        aggregator process exports ``goodput_ratio``/``alerts_total``
+        too, since it imports the telemetry package) gets ONE ``TYPE``
+        block holding the aggregator's unlabeled samples alongside the
+        replica-labeled ones, so neither side shadows the other."""
+        fed, _ = federate_metrics(self._per_replica_metrics())
+        combined = self.registry.snapshot()
+        for name, entry in fed.items():
+            cur = combined.get(name)
+            if cur is None:
+                combined[name] = entry
+            elif cur["type"] == entry["type"]:
+                cur["samples"] = list(cur["samples"]) + entry["samples"]
+            # type conflict: keep the aggregator's own family
+        return _registry.render_prometheus_snapshot(combined)
+
+    def fleetz(self) -> dict:
+        """The ``/fleetz`` payload: per-replica table + fleet rollups
+        (counter sums, gauge min/max/sum, SLO attainment, fleet-wide
+        tail latencies off merged histograms via the one
+        ``registry.pct`` convention)."""
+        merged, issues = self.merged()
+        rows = self.replicas()
+        counters = {name: round(sum(s["value"] for s in e["samples"]), 6)
+                    for name, e in merged.items()
+                    if e["type"] == "counter"}
+        gauges = {name: {
+            "min": min((s["min"] for s in e["samples"]
+                        if s["min"] is not None), default=None),
+            "max": max((s["max"] for s in e["samples"]
+                        if s["max"] is not None), default=None),
+            "sum": round(sum(s["sum"] for s in e["samples"]), 6)}
+            for name, e in merged.items() if e["type"] == "gauge"}
+        met = counters.get("serving_slo_met_total")
+        viol = counters.get("serving_slo_violations_total")
+        slo = None
+        if met is not None or viol is not None:
+            met, viol = met or 0.0, viol or 0.0
+            slo = {"met": met, "violated": viol,
+                   "attainment": None if met + viol == 0
+                   else round(met / (met + viol), 6)}
+        ttft_h = family_histogram(merged.get("serving_ttft_seconds"))
+        tpot_h = family_histogram(merged.get("serving_tpot_ms"))
+        ttft_p99 = None if ttft_h is None else histogram_quantile(
+            ttft_h, 0.99)
+        tpot_p99 = None if tpot_h is None else histogram_quantile(
+            tpot_h, 0.99)
+        states = {s: sum(1 for r in rows if r.state == s)
+                  for s in HEALTH_STATES}
+        # fleet goodput: wall-weighted mean of per-replica ratios when
+        # the wall gauge is exported, plain mean otherwise
+        ratios = [(r.goodput_ratio, g) for r, g in (
+            (row, self._wall_for(row.name)) for row in rows)
+            if r.goodput_ratio is not None]
+        goodput = None
+        if ratios:
+            if all(g is not None and g > 0 for _, g in ratios):
+                goodput = sum(r * g for r, g in ratios) \
+                    / sum(g for _, g in ratios)
+            else:
+                goodput = sum(r for r, _ in ratios) / len(ratios)
+            goodput = round(goodput, 6)
+        return {
+            "t": time.time(),
+            "rounds": self._rounds,
+            "replicas": {r.name: r.as_dict() for r in rows},
+            "fleet": {
+                "states": states,
+                "total_queue_depth": self.total_queue_depth(),
+                "active_slots": sum(r.active_slots or 0 for r in rows),
+                "goodput_ratio": goodput,
+                "slo": slo,
+                "ttft_p99_ms": None if ttft_p99 is None
+                else round(ttft_p99 * 1e3, 3),
+                "tpot_p99_ms": None if tpot_p99 is None
+                else round(tpot_p99, 3),
+                "counters": counters,
+                "gauges": gauges,
+            },
+            "issues": issues,
+        }
+
+    def _wall_for(self, name: str) -> Optional[float]:
+        with self._lock:
+            rep = self._reps.get(name)
+        if rep is None:
+            return None
+        return metric_total(rep.metrics, "goodput_wall_seconds_total")
+
+
+# ---------------------------------------------------------------------------
+# the /fleetz HTTP surface
+# ---------------------------------------------------------------------------
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    view: FleetView = None          # type: ignore[assignment]
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):               # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/fleetz":
+                self._send(200, json.dumps(self.view.fleetz()).encode(),
+                           "application/json")
+            elif path == "/metrics":
+                _registry.run_collectors()
+                self._send(200, self.view.federated_prometheus().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                rows = self.view.replicas()
+                payload = {
+                    "ok": True,
+                    "replicas": {s: sum(1 for r in rows if r.state == s)
+                                 for s in HEALTH_STATES}}
+                self._send(200, json.dumps(payload).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found: try /fleetz /metrics "
+                                b"/healthz\n", "text/plain")
+        except BrokenPipeError:
+            pass
+        except Exception as e:      # a scrape must never kill the plane
+            try:
+                self._send(500, repr(e).encode(), "text/plain")
+            except Exception:
+                pass
+
+    def log_message(self, fmt, *args):
+        logger.debug("fleet server: " + fmt % args)
+
+
+class FleetServer:
+    """HTTP server over a :class:`FleetView`: ``/fleetz`` (the table),
+    ``/metrics`` (federated), ``/healthz`` (aggregator liveness)."""
+
+    def __init__(self, view: FleetView, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.view = view
+        self.host = host
+        self._requested_port = int(port)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self.host}:{self.port}" if self._server else None
+
+    def start(self) -> "FleetServer":
+        if self._server is not None:
+            return self
+        handler = type("_BoundFleetHandler", (_FleetHandler,),
+                       {"view": self.view})
+        self._server = ThreadingHTTPServer(
+            (self.host, self._requested_port), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dstpu-fleetz",
+            daemon=True)
+        self._thread.start()
+        logger.info(f"fleet aggregator serving /fleetz /metrics /healthz "
+                    f"on {self.url}")
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None

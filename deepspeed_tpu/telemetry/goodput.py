@@ -84,7 +84,7 @@ class GoodputTracker:
         self._h = reg.histogram(
             "goodput_phase_seconds",
             "per-occurrence wall time by phase (exclusive attribution)",
-            labelnames=("phase",))
+            labelnames=("phase",), buckets=_registry.SECONDS_BUCKETS)
         self._c = reg.counter(
             "goodput_phase_seconds_total",
             "cumulative wall seconds by phase", labelnames=("phase",))

@@ -37,6 +37,9 @@ __all__ = [
     "get_registry", "counter", "gauge", "histogram",
     "maybe_install_exit_dump", "flush_exit_dump", "register_collector",
     "run_collectors", "METRICS_DIR_ENV", "pct",
+    "render_prometheus_snapshot",
+    "SECONDS_BUCKETS", "MS_BUCKETS", "TPOT_MS_BUCKETS",
+    "ACCEPT_LEN_BUCKETS", "BUCKET_SCHEMAS",
 ]
 
 METRICS_DIR_ENV = "DSTPU_METRICS_DIR"
@@ -57,6 +60,41 @@ def pct(sorted_xs, q: float) -> float:
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+# -- named bucket schemas ----------------------------------------------
+# Every ``histogram()`` call site references ONE of these by name instead
+# of declaring ad-hoc tuples: the fleet aggregator
+# (``telemetry/fleet.py``) merges histograms bucket-wise across replicas
+# and can only assert "one schema per metric family" if the schemas are
+# declared once.  ``serving_tpot_ms`` growing sub-ms buckets while other
+# ms-histograms kept defaults is exactly the drift this centralization
+# ends.
+#
+# seconds-denominated wall times (train steps, TTFT, checkpoint writes)
+SECONDS_BUCKETS: Tuple[float, ...] = DEFAULT_BUCKETS
+# ms-denominated wall times with a web-ish floor (scrape round-trips,
+# queueing delays): 0.1 ms .. minutes
+MS_BUCKETS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
+# ms-denominated per-output-token latency: fused+paged decode on real
+# chips lands in the tens of MICROseconds (below MS_BUCKETS' 0.1 floor,
+# which collapsed the p50/p99 the anomaly detectors read), CPU-mesh
+# tests in seconds
+TPOT_MS_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0)
+# accepted drafts per slot per verify tick land in [0, k]; covers any
+# sane k without re-registering per config
+ACCEPT_LEN_BUCKETS: Tuple[float, ...] = (
+    0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+
+BUCKET_SCHEMAS: Dict[str, Tuple[float, ...]] = {
+    "seconds": SECONDS_BUCKETS,
+    "ms": MS_BUCKETS,
+    "tpot_ms": TPOT_MS_BUCKETS,
+    "accept_len": ACCEPT_LEN_BUCKETS,
+}
 
 
 def _escape_label_value(v: str) -> str:
@@ -321,29 +359,7 @@ class Registry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format (v0.0.4)."""
-        lines = []
-        for name, entry in self.snapshot().items():
-            if entry["help"]:
-                lines.append(f"# HELP {name} {entry['help']}")
-            lines.append(f"# TYPE {name} {entry['type']}")
-            for s in entry["samples"]:
-                base_labels = ",".join(
-                    f'{k}="{_escape_label_value(v)}"'
-                    for k, v in s["labels"].items())
-                if entry["type"] == "histogram":
-                    for le, c in s["buckets"].items():
-                        ls = (base_labels + "," if base_labels else "") \
-                            + f'le="{le}"'
-                        lines.append(f"{name}_bucket{{{ls}}} {c}")
-                    suffix = f"{{{base_labels}}}" if base_labels else ""
-                    lines.append(
-                        f"{name}_sum{suffix} {_fmt_value(s['sum'])}")
-                    lines.append(f"{name}_count{suffix} {s['count']}")
-                else:
-                    suffix = f"{{{base_labels}}}" if base_labels else ""
-                    lines.append(
-                        f"{name}{suffix} {_fmt_value(s['value'])}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        return render_prometheus_snapshot(self.snapshot())
 
     def dump(self, path: str) -> None:
         """Write ``snapshot()`` as JSON (atomic rename)."""
@@ -359,6 +375,39 @@ class Registry:
         """Drop every metric (test isolation helper)."""
         with self._lock:
             self._metrics.clear()
+
+
+def render_prometheus_snapshot(snap: dict) -> str:
+    """Prometheus text exposition for a ``snapshot()``-shaped dict.
+
+    Module-level (not a ``Registry`` method) because the fleet
+    aggregator (``telemetry/fleet.py``) renders structures it PARSED
+    from remote replicas' ``/metrics`` with this same function —
+    ``parse_prometheus(render_prometheus())`` round-trips
+    byte-equivalently only because both directions share one renderer."""
+    lines = []
+    for name, entry in snap.items():
+        if entry["help"]:
+            lines.append(f"# HELP {name} {entry['help']}")
+        lines.append(f"# TYPE {name} {entry['type']}")
+        for s in entry["samples"]:
+            base_labels = ",".join(
+                f'{k}="{_escape_label_value(v)}"'
+                for k, v in s["labels"].items())
+            if entry["type"] == "histogram":
+                for le, c in s["buckets"].items():
+                    ls = (base_labels + "," if base_labels else "") \
+                        + f'le="{le}"'
+                    lines.append(f"{name}_bucket{{{ls}}} {c}")
+                suffix = f"{{{base_labels}}}" if base_labels else ""
+                lines.append(
+                    f"{name}_sum{suffix} {_fmt_value(s['sum'])}")
+                lines.append(f"{name}_count{suffix} {s['count']}")
+            else:
+                suffix = f"{{{base_labels}}}" if base_labels else ""
+                lines.append(
+                    f"{name}{suffix} {_fmt_value(s['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 _default_registry = Registry()

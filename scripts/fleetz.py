@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Fleet aggregator CLI (telemetry/fleet.py).
+
+Discovers N replica telemetry exporters, scrapes their ``/metrics`` /
+``/statusz`` / ``/healthz`` / ``/alertz``, merges them into one fleet
+view, and renders a live per-replica table (or serves ``/fleetz`` + a
+federated ``/metrics`` over HTTP).
+
+Modes:
+
+  # live table against two static replicas, refreshed every 2 s
+  python scripts/fleetz.py --replicas 127.0.0.1:9100,127.0.0.1:9101
+
+  # file discovery: watch the fleet.json the launcher writes into
+  # --metrics_dir (picks up OS-assigned ports and restarts)
+  python scripts/fleetz.py --discover /tmp/metrics/fleet.json
+
+  # one scrape round, print the table, exit (CI smoke / cron)
+  python scripts/fleetz.py --replicas ... --once --snapshot fleet.json
+
+  # serve /fleetz + federated /metrics for a router / Prometheus
+  python scripts/fleetz.py --discover ... --port 9200
+
+  # self-contained smoke: spin two in-process exporters with distinct
+  # registries, scrape them, assert the merge invariants (CI)
+  python scripts/fleetz.py --selftest --snapshot fleet_snapshot.json
+
+``DSTPU_FLEET_REPLICAS`` (comma-separated ``host:port``) is the
+flag-free discovery fallback.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet telemetry aggregator: scrape N replica "
+                    "exporters, merge, render /fleetz")
+    ap.add_argument("--replicas", type=str, default=None,
+                    help="comma-separated host:port list (static mode)")
+    ap.add_argument("--discover", type=str, default=None,
+                    help="path to the launcher-written fleet.json "
+                         "(file-discovery mode, re-read on change)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="scrape interval seconds (live/serve modes)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-endpoint fetch timeout seconds")
+    ap.add_argument("--port", type=int, default=None,
+                    help="serve /fleetz + federated /metrics on this "
+                         "port (0 = OS-assigned)")
+    ap.add_argument("--once", action="store_true",
+                    help="one scrape round, print, exit (exit 1 when "
+                         "no replica answered)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the /fleetz payload as JSON instead of "
+                         "the table")
+    ap.add_argument("--snapshot", type=str, default=None,
+                    help="write the /fleetz payload JSON here each round")
+    ap.add_argument("--rounds", type=int, default=0,
+                    help="exit after N rounds (0 = run forever)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="spin two in-process exporters and smoke the "
+                         "scrape/merge invariants (implies --once)")
+    return ap.parse_args(argv)
+
+
+def _fmt(v, spec="{:.3g}", none="-"):
+    return none if v is None else spec.format(v)
+
+
+def render_table(payload: dict) -> str:
+    """The /fleetz payload as a fixed-width per-replica table + fleet
+    rollup line."""
+    cols = ["REPLICA", "STATE", "QUEUE", "SLOTS", "HIT%", "GOODPUT",
+            "TTFT_P99", "TPOT_P99", "ALERTS", "AGE_S"]
+    rows = []
+    for name, r in payload["replicas"].items():
+        rows.append([
+            name, r["state"], _fmt(r["queue_depth"], "{:.0f}"),
+            _fmt(r["active_slots"], "{:.0f}"),
+            _fmt(None if r["prefix_hit_rate"] is None
+                 else 100 * r["prefix_hit_rate"], "{:.1f}"),
+            _fmt(r["goodput_ratio"], "{:.2f}"),
+            _fmt(r["ttft_p99_ms"], "{:.2f}ms"),
+            _fmt(r["tpot_p99_ms"], "{:.2f}ms"),
+            ",".join(r["active_alerts"]) or "-",
+            _fmt(r["last_scrape_age_s"], "{:.1f}"),
+        ])
+    widths = [max(len(c), *(len(row[i]) for row in rows)) if rows
+              else len(c) for i, c in enumerate(cols)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    f = payload["fleet"]
+    states = " ".join(f"{n} {s}" for s, n in f["states"].items() if n)
+    slo = f.get("slo")
+    lines.append(
+        f"fleet: {states or 'no replicas'} | queue "
+        f"{f['total_queue_depth']:.0f} | goodput "
+        f"{_fmt(f['goodput_ratio'], '{:.2f}')} | ttft p99 "
+        f"{_fmt(f['ttft_p99_ms'], '{:.2f}ms')} | tpot p99 "
+        f"{_fmt(f['tpot_p99_ms'], '{:.2f}ms')}"
+        + (f" | slo attainment {_fmt(slo['attainment'], '{:.3f}')}"
+           if slo else ""))
+    if payload["issues"]:
+        lines.append(f"merge issues: {payload['issues']}")
+    return "\n".join(lines)
+
+
+def _selftest(args) -> int:
+    """Two real in-process exporters on loopback with DISTINCT
+    registries → scrape → assert the fleet invariants CI cares about:
+    counter sums equal the sum of individual scrapes, gauges roll up
+    min/max/sum, best_for_prefix follows the hit counters."""
+    from deepspeed_tpu.telemetry import exporter, fleet
+    from deepspeed_tpu.telemetry import registry as registry_mod
+
+    regs, exps = [], []
+    hits = (400.0, 25.0)
+    for i, hit in enumerate(hits):
+        reg = registry_mod.Registry()
+        reg.counter("prefix_cache_hit_tokens_total",
+                    "prompt tokens served from cached prefix pages"
+                    ).inc(hit)
+        reg.counter("prefix_cache_miss_tokens_total",
+                    "prompt tokens prefilled").inc(100.0)
+        reg.gauge("serving_queue_depth", "queued + parked").set(2 + i)
+        reg.gauge("serving_active_slots", "occupied slots").set(4)
+        h = reg.histogram("serving_ttft_seconds", "submit -> first token",
+                          buckets=registry_mod.SECONDS_BUCKETS)
+        for v in (0.01, 0.02, 0.3):
+            h.observe(v)
+        regs.append(reg)
+        exps.append(exporter.TelemetryExporter(port=0, registry=reg)
+                    .start())
+    targets = [f"127.0.0.1:{ex.port}" for ex in exps]
+    view = fleet.FleetView(targets, timeout_s=args.timeout,
+                           registry=registry_mod.Registry())
+    view.scrape_once()
+    payload = view.fleetz()
+    print(render_table(payload))
+    if args.snapshot:
+        with open(args.snapshot, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"snapshot -> {args.snapshot}")
+    failures = []
+    got = payload["fleet"]["counters"].get("prefix_cache_hit_tokens_total")
+    if got != sum(hits):
+        failures.append(f"counter sum {got} != {sum(hits)}")
+    qd = payload["fleet"]["gauges"].get("serving_queue_depth", {})
+    if (qd.get("min"), qd.get("max"), qd.get("sum")) != (2.0, 3.0, 5.0):
+        failures.append(f"gauge rollup wrong: {qd}")
+    best = view.best_for_prefix()
+    if best is None or best.target != targets[0]:
+        failures.append(f"best_for_prefix chose {best} not {targets[0]}")
+    states = [r.state for r in view.replicas()]
+    if states != ["healthy", "healthy"]:
+        failures.append(f"states {states}")
+    fed = view.federated_prometheus()
+    if f'replica="{targets[0]}"' not in fed:
+        failures.append("federated /metrics missing replica label")
+    for ex in exps:
+        ex.stop()
+    if failures:
+        print("SELFTEST FAIL:\n  " + "\n  ".join(failures))
+        return 1
+    print("SELFTEST PASS")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    if args.selftest:
+        return _selftest(args)
+    from deepspeed_tpu.telemetry import fleet
+
+    targets = [t.strip() for t in args.replicas.split(",") if t.strip()] \
+        if args.replicas else None
+    if targets is None and args.discover is None \
+            and not os.environ.get(fleet.FLEET_REPLICAS_ENV):
+        print("no replicas: pass --replicas, --discover, or set "
+              f"{fleet.FLEET_REPLICAS_ENV}", file=sys.stderr)
+        return 2
+    view = fleet.FleetView(targets, discovery_file=args.discover,
+                           interval_s=args.interval,
+                           timeout_s=args.timeout)
+    server = None
+    if args.port is not None:
+        server = fleet.FleetServer(view, port=args.port).start()
+        print(f"serving /fleetz on {server.url}")
+    rounds = 0
+    try:
+        while True:
+            results = view.scrape_once()
+            payload = view.fleetz()
+            if args.snapshot:
+                with open(args.snapshot, "w") as fh:
+                    json.dump(payload, fh, indent=1)
+            if args.json:
+                print(json.dumps(payload))
+            else:
+                print(render_table(payload))
+            rounds += 1
+            if args.once or (args.rounds and rounds >= args.rounds):
+                return 0 if any(results.values()) else 1
+            print()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if server is not None:
+            server.stop()
+        view.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,455 @@
+"""Fast host units for the fleet telemetry plane (telemetry/fleet.py):
+prometheus parse/render round-trip, per-kind merge semantics + the
+mismatched-bucket guard, the replica health state machine, and
+discovery-file parsing/aggregation.
+
+Everything here is hand-built registries and scripted observations —
+no sockets, no models — so the file stays cheap inside the tier-1
+window.  The loopback e2e (real exporters, a killed replica reaching
+``down`` with exactly one alert) lives z-sorted in ``test_zfleet.py``.
+"""
+import json
+import math
+import os
+
+import pytest
+
+from deepspeed_tpu.launcher import runner
+from deepspeed_tpu.telemetry import anomaly, fleet
+from deepspeed_tpu.telemetry import registry as telemetry_registry
+from deepspeed_tpu.telemetry.registry import (
+    Registry, render_prometheus_snapshot)
+
+
+# ----------------------------------------------------------------------
+# parse/render round-trip
+# ----------------------------------------------------------------------
+def _populated_registry() -> Registry:
+    r = Registry()
+    r.counter("reqs_total", "requests served").inc(3)
+    r.counter("errs_total", "errors", labelnames=("kind", "site")) \
+        .labels(kind="bad", site="a").inc(2.5)
+    g = r.gauge("depth", "queue depth")
+    g.set(7.25)
+    r.gauge("ratio")  # no help line, no samples yet
+    h = r.histogram("lat_seconds", "latency", labelnames=("route",),
+                    buckets=(0.001, 0.1, 1.0))
+    h.labels(route="/a").observe(0.05)
+    h.labels(route="/a").observe(0.5)
+    h.labels(route="/b").observe(5.0)
+    r.histogram("plain_h", "unlabeled").observe(0.2)
+    return r
+
+
+def test_round_trip_every_metric_kind():
+    # THE acceptance contract: parse(render()) re-renders byte-equal
+    # for counters, labeled counters, gauges, labeled histograms and
+    # unlabeled histograms in one exposition
+    text = _populated_registry().render_prometheus()
+    parsed = fleet.parse_prometheus(text)
+    assert render_prometheus_snapshot(parsed) == text
+
+
+def test_round_trip_label_escaping():
+    r = Registry()
+    r.counter("esc_total", "escapes", labelnames=("v",)) \
+        .labels(v='quote " backslash \\ newline \n comma , brace }') \
+        .inc()
+    text = r.render_prometheus()
+    parsed = fleet.parse_prometheus(text)
+    assert render_prometheus_snapshot(parsed) == text
+    # and the VALUE itself survives (not just the escaped bytes)
+    labels = parsed["esc_total"]["samples"][0]["labels"]
+    assert labels["v"] == 'quote " backslash \\ newline \n comma , brace }'
+
+
+def test_round_trip_inf_and_int_formatting():
+    r = Registry()
+    r.gauge("big").set(float("inf"))
+    r.gauge("neg").set(float("-inf"))
+    r.gauge("int_like").set(42.0)
+    r.gauge("frac").set(0.1)
+    text = r.render_prometheus()
+    parsed = fleet.parse_prometheus(text)
+    assert render_prometheus_snapshot(parsed) == text
+    assert parsed["big"]["samples"][0]["value"] == math.inf
+
+
+def test_round_trip_default_registry_render():
+    # the process default registry (whatever PRs 1-9 declared on it) —
+    # every kind in the real exposition round-trips
+    reg = telemetry_registry.get_registry()
+    reg.counter("fleet_test_probe_total", "round-trip probe").inc()
+    text = reg.render_prometheus()
+    parsed = fleet.parse_prometheus(text)
+    assert render_prometheus_snapshot(parsed) == text
+
+
+def test_parse_histogram_structure():
+    text = _populated_registry().render_prometheus()
+    parsed = fleet.parse_prometheus(text)
+    entry = parsed["lat_seconds"]
+    assert entry["type"] == "histogram"
+    rows = {tuple(s["labels"].items()): s for s in entry["samples"]}
+    a = rows[(("route", "/a"),)]
+    assert a["count"] == 2 and a["sum"] == pytest.approx(0.55)
+    assert list(a["buckets"]) == ["0.001", "0.1", "1", "+Inf"]
+    assert a["buckets"]["+Inf"] == 2 and a["buckets"]["0.1"] == 1
+
+
+# ----------------------------------------------------------------------
+# merge semantics per metric kind
+# ----------------------------------------------------------------------
+def _parsed(reg: Registry) -> dict:
+    return fleet.parse_prometheus(reg.render_prometheus())
+
+
+def test_merge_counters_sum_per_labelset():
+    a, b = Registry(), Registry()
+    a.counter("x_total").inc(3)
+    b.counter("x_total").inc(4)
+    a.counter("l_total", labelnames=("k",)).labels(k="p").inc(1)
+    b.counter("l_total", labelnames=("k",)).labels(k="p").inc(2)
+    b.counter("l_total", labelnames=("k",)).labels(k="q").inc(5)
+    merged, issues = fleet.merge_metrics({"a": _parsed(a), "b": _parsed(b)})
+    assert not issues
+    assert merged["x_total"]["samples"][0]["value"] == 7
+    rows = {tuple(s["labels"].items()): s["value"]
+            for s in merged["l_total"]["samples"]}
+    assert rows[(("k", "p"),)] == 3 and rows[(("k", "q"),)] == 5
+
+
+def test_merge_gauges_keep_per_replica_rollups():
+    a, b = Registry(), Registry()
+    a.gauge("depth").set(3)
+    b.gauge("depth").set(9)
+    merged, issues = fleet.merge_metrics({"a": _parsed(a), "b": _parsed(b)})
+    assert not issues
+    s = merged["depth"]["samples"][0]
+    # NOT summed into one number: min/max/sum + per-replica values
+    assert s["min"] == 3 and s["max"] == 9 and s["sum"] == 12
+    assert s["by_replica"] == {"a": 3.0, "b": 9.0}
+
+
+def test_merge_histograms_bucket_wise():
+    a, b = Registry(), Registry()
+    for reg, vals in ((a, (0.05, 0.5)), (b, (0.05, 50.0))):
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+        for v in vals:
+            h.observe(v)
+    merged, issues = fleet.merge_metrics({"a": _parsed(a), "b": _parsed(b)})
+    assert not issues
+    s = merged["h_seconds"]["samples"][0]
+    # cumulative le-counts ADD exactly (the fixed-bucket design's point)
+    assert s["buckets"] == {"0.1": 2, "1": 3, "+Inf": 4}
+    assert s["count"] == 4 and s["sum"] == pytest.approx(50.6)
+
+
+def test_merge_mismatched_bucket_schema_guard():
+    a, b = Registry(), Registry()
+    a.histogram("h_seconds", buckets=(0.1, 1.0)).observe(0.05)
+    b.histogram("h_seconds", buckets=(0.1, 2.0)).observe(0.05)
+    merged, issues = fleet.merge_metrics({"a": _parsed(a), "b": _parsed(b)})
+    # never silently mis-merged: family dropped + reported
+    assert "h_seconds" not in merged
+    assert [i["kind"] for i in issues] == ["bucket_schema"]
+    assert issues[0]["metric"] == "h_seconds"
+
+
+def test_merge_type_conflict_guard():
+    a, b = Registry(), Registry()
+    a.counter("x_total").inc()
+    b.gauge("x_total").set(1)
+    merged, issues = fleet.merge_metrics({"a": _parsed(a), "b": _parsed(b)})
+    assert "x_total" not in merged
+    assert issues and issues[0]["kind"] == "type_conflict"
+
+
+def test_federate_injects_replica_label():
+    a, b = Registry(), Registry()
+    a.counter("x_total").inc(1)
+    b.counter("x_total").inc(2)
+    a.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+    fed, issues = fleet.federate_metrics({"r0": _parsed(a),
+                                          "r1": _parsed(b)})
+    assert not issues
+    text = render_prometheus_snapshot(fed)
+    assert 'x_total{replica="r0"} 1' in text
+    assert 'x_total{replica="r1"} 2' in text
+    assert 'h_seconds_bucket{replica="r0",le="1"} 1' in text
+
+
+def test_histogram_quantile_nearest_rank():
+    r = Registry()
+    h = r.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in [0.05] * 98 + [5.0, 5.0]:
+        h.observe(v)
+    s = fleet.family_histogram(_parsed(r)["h_seconds"])
+    # p50 rank 50 → first bucket; p99 rank 99 → the 10.0 bucket
+    assert fleet.histogram_quantile(s, 0.50) == pytest.approx(0.1)
+    assert fleet.histogram_quantile(s, 0.99) == pytest.approx(10.0)
+    assert fleet.histogram_quantile({"buckets": {}, "count": 0},
+                                    0.99) is None
+
+
+# ----------------------------------------------------------------------
+# replica health state machine
+# ----------------------------------------------------------------------
+def test_health_scripted_fail_to_down():
+    h = fleet.ReplicaHealth(stale_after=2, down_after=4, clear_after=2)
+    assert h.state == "stale"                     # no data yet
+    assert h.observe(True) == ("stale", "healthy")   # first contact: 1 ok
+    assert h.observe(False) is None               # 1 fail < stale_after
+    assert h.observe(False) == ("healthy", "stale")
+    assert h.observe(False) is None
+    assert h.observe(False) == ("stale", "down")
+    assert h.observe(False) is None               # stays down, no re-fire
+
+
+def test_health_recovery_needs_clear_after():
+    h = fleet.ReplicaHealth(stale_after=1, down_after=2, clear_after=3)
+    h.observe(True)
+    for _ in range(2):
+        h.observe(False)
+    assert h.state == "down"
+    assert h.observe(True) is None                # 1 ok suppressed
+    assert h.observe(True) is None                # 2 ok suppressed
+    assert h.observe(True) == ("down", "healthy")  # 3rd ok clears
+
+
+def test_health_flap_suppression():
+    # alternating fail/ok: failure streak resets on every success, so
+    # the machine neither leaves healthy nor (once down) recovers
+    h = fleet.ReplicaHealth(stale_after=2, down_after=4, clear_after=2)
+    h.observe(True)
+    for _ in range(6):
+        assert h.observe(False) is None
+        assert h.observe(True) is None or h.state == "healthy"
+    assert h.state == "healthy"
+    for _ in range(4):
+        h.observe(False)
+    assert h.state == "down"
+    for _ in range(6):
+        h.observe(True)
+        h.observe(False)
+    assert h.state == "down"                       # ok streak never lasts
+
+
+def test_health_degraded_via_healthz():
+    h = fleet.ReplicaHealth(degrade_after=2, clear_after=2)
+    h.observe(True)
+    assert h.observe(True, healthz_ok=False) is None
+    assert h.observe(True, healthz_ok=False) == ("healthy", "degraded")
+    assert h.observe(True, healthz_ok=True) is None
+    assert h.observe(True, healthz_ok=True) == ("degraded", "healthy")
+    # healthz None (endpoint missing) is neutral, not degrading
+    h2 = fleet.ReplicaHealth(degrade_after=1)
+    h2.observe(True)
+    assert h2.observe(True, healthz_ok=None) is None
+    assert h2.state == "healthy"
+
+
+def test_health_validates_thresholds():
+    with pytest.raises(ValueError):
+        fleet.ReplicaHealth(stale_after=5, down_after=2)
+
+
+# ----------------------------------------------------------------------
+# discovery
+# ----------------------------------------------------------------------
+def test_read_discovery_sorted_and_validated(tmp_path):
+    p = tmp_path / "fleet.json"
+    p.write_text(json.dumps({"replicas": [
+        {"rank": 1, "host": "h1", "port": 9101},
+        {"rank": 0, "host": "h0", "port": 9100},
+    ]}))
+    entries = fleet.read_discovery(str(p))
+    assert [e["rank"] for e in entries] == [0, 1]
+    p.write_text(json.dumps({"replicas": [{"host": "h"}]}))
+    with pytest.raises(ValueError):
+        fleet.read_discovery(str(p))
+    p.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(ValueError):
+        fleet.read_discovery(str(p))
+
+
+def test_resolve_targets_precedence(tmp_path, monkeypatch):
+    p = tmp_path / "fleet.json"
+    p.write_text(json.dumps({"replicas": [
+        {"rank": 0, "host": "h0", "port": 9100}]}))
+    monkeypatch.setenv(fleet.FLEET_REPLICAS_ENV, "e:1,e:2")
+    # explicit targets beat the file beat the env
+    assert fleet.resolve_targets(["s:1"], str(p)) == {"s:1": "s:1"}
+    assert fleet.resolve_targets(None, str(p)) == {"rank0": "h0:9100"}
+    assert fleet.resolve_targets() == {"e:1": "e:1", "e:2": "e:2"}
+    monkeypatch.delenv(fleet.FLEET_REPLICAS_ENV)
+    assert fleet.resolve_targets() == {}
+
+
+def test_launcher_fleet_discovery_aggregation(tmp_path):
+    # exporter-side per-rank files -> the launcher's single fleet.json
+    d = str(tmp_path)
+    for rank, port in ((1, 9101), (0, 9100)):
+        with open(os.path.join(d, f"telemetry_rank{rank}.json"),
+                  "w") as fh:
+            json.dump({"rank": rank, "host": "127.0.0.1", "port": port,
+                       "pid": 1000 + rank}, fh)
+    state: dict = {}
+    runner._update_fleet_discovery(d, state, num_processes=2)
+    doc = json.loads((tmp_path / "fleet.json").read_text())
+    assert [r["rank"] for r in doc["replicas"]] == [0, 1]
+    assert doc["replicas"][0]["port"] == 9100
+    assert doc["num_processes"] == 2
+    mtime = os.path.getmtime(tmp_path / "fleet.json")
+    # unchanged set -> not rewritten
+    runner._update_fleet_discovery(d, state, num_processes=2)
+    assert os.path.getmtime(tmp_path / "fleet.json") == mtime
+    # fleet.py consumes what the launcher wrote
+    assert fleet.resolve_targets(None, str(tmp_path / "fleet.json")) == {
+        "rank0": "127.0.0.1:9100", "rank1": "127.0.0.1:9101"}
+    # a torn/partial per-rank file is skipped, not fatal
+    (tmp_path / "telemetry_rank2.json").write_text("{not json")
+    runner._update_fleet_discovery(d, state, num_processes=3)
+    doc = json.loads((tmp_path / "fleet.json").read_text())
+    assert len(doc["replicas"]) == 2
+
+
+def test_launcher_reset_fleet_discovery(tmp_path):
+    (tmp_path / "telemetry_rank0.json").write_text("{}")
+    (tmp_path / "fleet.json").write_text("{}")
+    (tmp_path / "metrics_rank0.json").write_text("{}")   # NOT removed
+    runner._reset_fleet_discovery(str(tmp_path))
+    assert sorted(p.name for p in tmp_path.iterdir()) == \
+        ["metrics_rank0.json"]
+
+
+# ----------------------------------------------------------------------
+# FleetView over a fake transport (no sockets)
+# ----------------------------------------------------------------------
+class _FakeFleet(fleet.FleetView):
+    """FleetView whose transport is a dict of registries — the unit seam
+    for scrape/merge/health without binding ports."""
+
+    def __init__(self, regs, dead=None, **kw):
+        self._regs = regs
+        self.dead = set(dead or ())
+        kw.setdefault("registry", Registry())
+        kw.setdefault("anomaly_engine",
+                      anomaly.AnomalyEngine(detectors=[],
+                                            registry=Registry()))
+        kw.setdefault("health_knobs",
+                      dict(stale_after=2, down_after=3, clear_after=2))
+        super().__init__(list(regs), **kw)
+
+    def _fetch(self, target, path):
+        if target in self.dead:
+            raise OSError("connection refused")
+        reg = self._regs[target]
+        if path == "/metrics":
+            return 200, reg.render_prometheus().encode()
+        if path == "/healthz":
+            return 200, json.dumps({"ok": True}).encode()
+        if path == "/statusz":
+            return 200, json.dumps(
+                {"serving": {"queued": 1, "parked": 0}}).encode()
+        if path == "/alertz":
+            return 200, json.dumps({"active": []}).encode()
+        return 404, b""
+
+
+def _serving_regs():
+    regs = {}
+    for name, hit, depth in (("a:1", 90.0, 4), ("b:2", 10.0, 1)):
+        r = Registry()
+        r.counter("prefix_cache_hit_tokens_total").inc(hit)
+        r.counter("prefix_cache_miss_tokens_total").inc(10.0)
+        r.gauge("serving_queue_depth").set(depth)
+        r.gauge("serving_active_slots").set(2)
+        regs[name] = r
+    return regs
+
+
+def test_fleetview_rollup_and_seam():
+    v = _FakeFleet(_serving_regs())
+    v.scrape_once()
+    assert [r.state for r in v.replicas()] == ["healthy", "healthy"]
+    assert v.healthy() and len(v.healthy()) == 2
+    assert v.total_queue_depth() == 5.0
+    assert v.best_for_prefix().name == "a:1"
+    fz = v.fleetz()
+    assert fz["fleet"]["counters"]["prefix_cache_hit_tokens_total"] == 100
+    assert fz["replicas"]["a:1"]["prefix_hit_rate"] == \
+        pytest.approx(0.9)
+    assert fz["fleet"]["states"]["healthy"] == 2
+
+
+def test_fleetview_down_excluded_from_seam():
+    v = _FakeFleet(_serving_regs())
+    v.scrape_once()
+    v.dead.add("a:1")
+    for _ in range(3):
+        v.scrape_once()
+    states = {r.name: r.state for r in v.replicas()}
+    assert states["a:1"] == "down"
+    # the router seam never hands out a dead replica, even the one with
+    # the better prefix counters; its stale queue depth is not backlog
+    assert v.best_for_prefix().name == "b:2"
+    assert v.total_queue_depth() == 1.0
+    assert v.healthy()[0].name == "b:2"
+    evs = [e for e in v._anomaly.recent(50)
+           if e["rule"] == "fleet_replica_down"]
+    assert [e["state"] for e in evs] == ["firing"]
+
+
+def test_federated_metrics_shared_family_names_merge():
+    # the aggregator process itself exports goodput_ratio/alerts_total
+    # (it imports the telemetry package) — replica series under the
+    # SAME names must still reach the federated /metrics, as
+    # replica-labeled samples inside ONE family block
+    regs = _serving_regs()
+    for r in regs.values():
+        r.gauge("goodput_ratio").set(0.5)
+    own = Registry()
+    own.gauge("goodput_ratio").set(0.0)          # the aggregator's own
+    v = _FakeFleet(regs, registry=own)
+    v.scrape_once()
+    text = v.federated_prometheus()
+    assert 'goodput_ratio{replica="a:1"} 0.5' in text
+    assert text.count("# TYPE goodput_ratio gauge") == 1
+    assert "fleet_scrapes_total" in text
+    # the whole federated body still parses as one exposition
+    assert "goodput_ratio" in fleet.parse_prometheus(text)
+
+
+def test_removed_replica_zeroes_state_gauge():
+    regs = _serving_regs()
+    v = _FakeFleet(regs)
+    v.scrape_once()
+    # shrink discovery to one replica: b:2 disappears
+    v._static_targets = ["a:1"]
+    v.scrape_once()
+    assert [r.name for r in v.replicas()] == ["a:1"]
+    snap = v.registry.snapshot()["fleet_replica_state"]
+    by = {tuple(sorted(s["labels"].items())): s["value"]
+          for s in snap["samples"]}
+    # no state left asserting 1.0 for the removed replica
+    for s in fleet.HEALTH_STATES:
+        assert by[(("replica", "b:2"), ("state", s))] == 0.0
+
+
+def test_fleetview_down_alert_fires_and_clears_once():
+    v = _FakeFleet(_serving_regs())
+    v.scrape_once()
+    v.dead.add("b:2")
+    for _ in range(6):                   # well past down_after: no re-fire
+        v.scrape_once()
+    v.dead.clear()
+    for _ in range(3):
+        v.scrape_once()
+    evs = [(e["state"], e["detail"].get("replica"))
+           for e in v._anomaly.recent(50)
+           if e["rule"] == "fleet_replica_down"]
+    assert evs == [("firing", "b:2"), ("cleared", "b:2")]
+    assert v._anomaly.active() == {}
+    st = {r.name: r.state for r in v.replicas()}
+    assert st["b:2"] == "healthy"

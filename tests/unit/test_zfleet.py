@@ -1,0 +1,164 @@
+"""Loopback e2e for the fleet telemetry plane: 2–3 REAL in-process
+exporters (distinct registries) scraped by a real FleetView over HTTP.
+
+THE acceptance surface: ``/fleetz`` counter sums equal the sum of the
+individual scrapes, a killed exporter walks stale→down firing exactly
+one structured alert, and ``best_for_prefix`` follows the
+``prefix_cache_hit_tokens`` counters.  z-sorted (the tier-1 window
+convention) — socket setup costs a few hundred ms, not hours, but the
+fast host units in ``test_fleet.py`` must run first.
+"""
+import json
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry import anomaly, exporter, fleet
+from deepspeed_tpu.telemetry.registry import Registry
+
+_HEALTH = dict(stale_after=2, down_after=4, clear_after=2)
+
+
+def _exporters(n=3):
+    """n real exporters on OS-assigned loopback ports, each serving a
+    DISTINCT registry populated with serving-shaped metrics."""
+    exps, regs = [], []
+    for i in range(n):
+        r = Registry()
+        r.counter("prefix_cache_hit_tokens_total",
+                  "prompt tokens served from cached prefix pages") \
+            .inc(100.0 * (n - i))            # replica 0 has the hottest cache
+        r.counter("prefix_cache_miss_tokens_total",
+                  "prompt tokens prefilled").inc(50.0)
+        r.counter("serving_requests_completed_total",
+                  "requests retired").inc(7 + i)
+        r.gauge("serving_queue_depth", "queued + parked").set(2 + i)
+        r.gauge("serving_active_slots", "occupied slots").set(4)
+        h = r.histogram("serving_ttft_seconds", "submit -> first token")
+        for v in (0.01, 0.02, 0.04):
+            h.observe(v)
+        regs.append(r)
+        exps.append(exporter.TelemetryExporter(port=0, registry=r).start())
+    return exps, regs
+
+
+@pytest.fixture
+def fleet_rig():
+    exps, regs = _exporters(3)
+    eng = anomaly.AnomalyEngine(detectors=[], registry=Registry())
+    view = fleet.FleetView(
+        [f"127.0.0.1:{e.port}" for e in exps], timeout_s=5.0,
+        registry=Registry(), anomaly_engine=eng, health_knobs=_HEALTH)
+    yield exps, regs, view, eng
+    for e in exps:
+        e.stop()
+    view.stop()
+
+
+def test_fleetz_sums_match_per_replica_scrapes(fleet_rig):
+    exps, regs, view, _ = fleet_rig
+    view.scrape_once()
+    # independent ground truth: scrape each exporter directly
+    per = []
+    for e in exps:
+        with urllib.request.urlopen(f"{e.url}/metrics", timeout=5) as r:
+            per.append(fleet.parse_prometheus(r.read().decode()))
+    fz = view.fleetz()
+    for name in ("prefix_cache_hit_tokens_total",
+                 "serving_requests_completed_total"):
+        want = sum(fleet.metric_total(p, name) for p in per)
+        assert fz["fleet"]["counters"][name] == want
+    # gauge rollup: min/max over the three depths 2,3,4
+    qd = fz["fleet"]["gauges"]["serving_queue_depth"]
+    assert (qd["min"], qd["max"], qd["sum"]) == (2.0, 4.0, 9.0)
+    assert view.total_queue_depth() == 9.0
+    # merged histogram count = 9 observations across replicas
+    assert fz["fleet"]["ttft_p99_ms"] == pytest.approx(50.0)
+    assert all(r["state"] == "healthy"
+               for r in fz["replicas"].values())
+    assert not fz["issues"]
+
+
+def test_best_for_prefix_prefers_hit_counters(fleet_rig):
+    exps, regs, view, _ = fleet_rig
+    view.scrape_once()
+    best = view.best_for_prefix()
+    assert best.target == f"127.0.0.1:{exps[0].port}"
+    # shift the cache heat to replica 2 and rescrape: the seam follows
+    regs[2].counter("prefix_cache_hit_tokens_total").inc(1000.0)
+    view.scrape_once()
+    assert view.best_for_prefix().target == f"127.0.0.1:{exps[2].port}"
+
+
+def test_killed_exporter_stale_to_down_one_alert(fleet_rig):
+    exps, regs, view, eng = fleet_rig
+    view.scrape_once()
+    assert len(view.healthy()) == 3
+    victim = f"127.0.0.1:{exps[1].port}"
+    exps[1].stop()                      # the process "dies"
+    seen_stale = False
+    for _ in range(_HEALTH["down_after"] + 2):   # past down: no re-fire
+        view.scrape_once()
+        st = {r.target: r.state for r in view.replicas()}
+        seen_stale = seen_stale or st[victim] == "stale"
+    st = {r.target: r.state for r in view.replicas()}
+    assert seen_stale, "must pass through stale before down"
+    assert st[victim] == "down"
+    evs = [e for e in eng.recent(50) if e["rule"] == "fleet_replica_down"]
+    assert len(evs) == 1 and evs[0]["state"] == "firing"
+    assert evs[0]["detail"]["target"] == victim
+    assert list(eng.active()) == [f"fleet_replica_down[{victim}]"]
+    # the live replicas keep serving the seam
+    assert len(view.healthy()) == 2
+    assert view.best_for_prefix().target != victim
+    # fleet_replica_state gauge flipped for the victim
+    name = next(r.name for r in view.replicas() if r.target == victim)
+    snap = view.registry.snapshot()["fleet_replica_state"]
+    by = {tuple(sorted(s["labels"].items())): s["value"]
+          for s in snap["samples"]}
+    assert by[(("replica", name), ("state", "down"))] == 1.0
+    assert by[(("replica", name), ("state", "healthy"))] == 0.0
+
+
+def test_fleet_server_endpoints(fleet_rig):
+    exps, regs, view, _ = fleet_rig
+    view.scrape_once()
+    srv = fleet.FleetServer(view, port=0).start()
+    try:
+        with urllib.request.urlopen(f"{srv.url}/fleetz", timeout=5) as r:
+            fz = json.loads(r.read())
+        assert len(fz["replicas"]) == 3
+        assert fz["fleet"]["counters"]["prefix_cache_miss_tokens_total"] \
+            == 150.0
+        with urllib.request.urlopen(f"{srv.url}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        # federated: per-replica samples replica-labeled, aggregator's
+        # own fleet_* plane alongside
+        for e in exps:
+            assert f'replica="127.0.0.1:{e.port}"' in text
+        assert "fleet_scrapes_total" in text
+        # federated text itself parses (a downstream Prometheus can
+        # scrape the aggregator)
+        parsed = fleet.parse_prometheus(text)
+        assert "prefix_cache_hit_tokens_total" in parsed
+        with urllib.request.urlopen(f"{srv.url}/healthz", timeout=5) as r:
+            hz = json.loads(r.read())
+        assert hz["ok"] and hz["replicas"]["healthy"] == 3
+    finally:
+        srv.stop()
+
+
+def test_healthz_degradation_reaches_fleet_state(fleet_rig, monkeypatch):
+    # a 503ing /healthz (stale worker loop) degrades the replica while
+    # scrapes keep succeeding — the router can stop preferring it
+    # before the process dies
+    exps, regs, view, _ = fleet_rig
+    monkeypatch.setenv(exporter.HEALTHZ_STALE_ENV, "1e-9")
+    for _ in range(3):                  # degrade_after + slack
+        view.scrape_once()
+    states = {r.state for r in view.replicas()}
+    assert states == {"degraded"}
+    monkeypatch.delenv(exporter.HEALTHZ_STALE_ENV)
+    for _ in range(3):
+        view.scrape_once()
+    assert {r.state for r in view.replicas()} == {"healthy"}
