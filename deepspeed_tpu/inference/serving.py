@@ -31,7 +31,8 @@ import numpy as np
 
 from ..models import common as model_common
 from ..telemetry import (attribution, goodput, memory as telemetry_memory,
-                         recompile, registry as telemetry_registry, trace)
+                         recompile, registry as telemetry_registry,
+                         reqtrace as telemetry_reqtrace, trace)
 from ..telemetry.registry import pct as _pct
 from . import kvreuse
 from . import specdec as specdec_mod
@@ -201,6 +202,14 @@ class ContinuousBatcher:
             "serving_active_slots", "occupied decode slots")
         self._m_queue = telemetry_registry.gauge(
             "serving_queue_depth", "queued + parked requests")
+        # queue wait (submit → prefill start) as a first-class
+        # histogram: previously only derivable from loadgen waterfalls,
+        # invisible to /metrics and the fleet rollup.  MS_BUCKETS — the
+        # declared schema, so the fleet merge can assert one layout.
+        self._m_queue_wait = telemetry_registry.histogram(
+            "serving_queue_wait_ms",
+            "submit -> prefill start (queueing for admission), ms",
+            buckets=telemetry_registry.MS_BUCKETS)
         # the _shrink_parked hazard, metered: parked rows pin their whole
         # B-row prefill cache BY REFERENCE, so the bytes held alive can be
         # B× what the parked-row count suggests
@@ -544,9 +553,25 @@ class ContinuousBatcher:
                 jax.jit(paged_retire_fn, out_shardings=_repl),
                 name="serving.retire_paged")
 
+        # request-scoped tracing (telemetry/reqtrace.py): attach the
+        # env-configured tracer as a lifecycle observer.  Off by
+        # default — no observer registers, every _note_lifecycle stays
+        # one truthiness check (the DSTPU002 zero-cost contract).
+        telemetry_reqtrace.maybe_attach(self)
+
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
-               top_p: float = 1.0, repetition_penalty: float = 1.0) -> int:
+               top_p: float = 1.0, repetition_penalty: float = 1.0,
+               trace_context=None) -> int:
+        """Queue a request; returns its uid.
+
+        ``trace_context`` (a ``traceparent`` string, a ``{"traceparent":
+        ...}`` dict, or a ``reqtrace.TraceContext``) joins this request
+        to an EXISTING distributed trace — the propagation seam a
+        multi-replica router uses when forwarding a request, so one
+        trace id survives the process hop.  It rides the ``submit``
+        lifecycle event; with no observers registered it costs
+        nothing."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -569,7 +594,9 @@ class ContinuousBatcher:
                                    temperature, top_p, repetition_penalty))
         self._t_submit[uid] = time.perf_counter()
         self._m_submitted.inc()
-        self._note_lifecycle(uid, "submit", queued=len(self._queue))
+        self._note_lifecycle(uid, "submit", queued=len(self._queue),
+                             **({"trace_context": trace_context}
+                                if trace_context is not None else {}))
         self._update_occupancy_gauges()
         return uid
 
@@ -597,11 +624,13 @@ class ContinuousBatcher:
     # -- per-request lifecycle + SLO ----------------------------------
     def add_lifecycle_observer(self, fn):
         """Register ``fn(t, uid, event, extra)`` for every request
-        lifecycle event; returns a zero-arg remover.  Events: ``submit``,
-        ``prefill_start`` (extra: hit_tokens/prefill_tokens/batch),
-        ``first_token``, ``place`` (extra: slot), ``emit`` (extra:
-        kind=decode|verify, n), ``retire`` (extra: n_out, ttft_ms,
-        tpot_ms, slo_ok).  Per uid, ``retire`` is always the LAST
+        lifecycle event; returns a zero-arg remover.  Events: ``submit``
+        (extra: queued, trace_context when propagated), ``prefill_start``
+        (extra: hit_tokens/prefill_tokens/batch/batch_uids — the
+        co-members sharing the batched prefill), ``first_token``,
+        ``place`` (extra: slot), ``emit`` (extra: kind=decode|verify, n,
+        tick — the window-END tick counter), ``retire`` (extra: n_out,
+        ttft_ms, tpot_ms, slo_ok).  Per uid, ``retire`` is always the LAST
         event — a pending emit window is flushed before it — so an
         observer may finalize a request's record at retire."""
         self._lifecycle_observers.append(fn)
@@ -824,12 +853,20 @@ class ContinuousBatcher:
             lens = np.asarray([len(r.prompt) - m0 for r in reqs], np.int32)
             # lifecycle: the queue→prefill boundary, with the prefix-
             # cache outcome (hit_tokens=0 ⇒ miss) — the waterfall's
-            # "queued" phase ends here for every request in the group
+            # "queued" phase ends here for every request in the group.
+            # ``batch_uids`` (the co-members sharing this prefill) land
+            # as request-trace span attributes; the queue-wait histogram
+            # makes the submit→prefill gap scrapeable.
+            t_pf = time.perf_counter()
+            batch_uids = [r.uid for r in reqs]
             for row, r in enumerate(reqs):
+                t_sub = self._t_submit.get(r.uid)
+                if t_sub is not None:
+                    self._m_queue_wait.observe((t_pf - t_sub) * 1e3)
                 self._note_lifecycle(r.uid, "prefill_start",
                                      hit_tokens=int(m0),
                                      prefill_tokens=int(lens[row]),
-                                     batch=B)
+                                     batch=B, batch_uids=batch_uids)
             cacheB = None
             try:
                 if m0:
@@ -953,11 +990,16 @@ class ContinuousBatcher:
             B = len(admitted)
             lens = np.asarray([len(r.prompt) - m0 for r in admitted],
                               np.int32)
+            t_pf = time.perf_counter()
+            batch_uids = [r.uid for r in admitted]
             for row, r in enumerate(admitted):
+                t_sub = self._t_submit.get(r.uid)
+                if t_sub is not None:
+                    self._m_queue_wait.observe((t_pf - t_sub) * 1e3)
                 self._note_lifecycle(r.uid, "prefill_start",
                                      hit_tokens=int(m0),
                                      prefill_tokens=int(lens[row]),
-                                     batch=B)
+                                     batch=B, batch_uids=batch_uids)
             # metas[:consumed] have found an owner (parked or released);
             # an exception anywhere before that — prefill, sampling, the
             # device fetch — rolls the REST back (free + unpin, NO tree
@@ -1298,7 +1340,7 @@ class ContinuousBatcher:
             # terminal for the uid
             if emitted_i:
                 self._note_lifecycle(act.req.uid, "emit", kind="verify",
-                                     n=emitted_i)
+                                     n=emitted_i, tick=self._tick_no)
             if retire_slot:
                 self._retire(i)
         if appended:
@@ -1456,12 +1498,13 @@ class ContinuousBatcher:
                         n_emit = emitted_by_uid.pop(act.req.uid, 0)
                         if n_emit:
                             self._note_lifecycle(act.req.uid, "emit",
-                                                 kind="decode", n=n_emit)
+                                                 kind="decode", n=n_emit,
+                                                 tick=self._tick_no)
                         self._retire(i)
             if self._lifecycle_observers:
                 for uid, n_emit in emitted_by_uid.items():
                     self._note_lifecycle(uid, "emit", kind="decode",
-                                         n=n_emit)
+                                         n=n_emit, tick=self._tick_no)
             if appended:
                 self._note_tpot(time.perf_counter() - t_window, appended)
             if self.specdec is not None:

@@ -29,6 +29,13 @@ Seven coordinated surfaces replacing the reference's scattered
   merges them per metric kind, runs a per-replica health state
   machine, and serves ``/fleetz`` + a federated ``/metrics`` — the
   ``FleetView`` seam the multi-replica router steers by.
+- :mod:`.reqtrace` — request-scoped distributed tracing: per-request
+  span trees off the serving lifecycle observers (``traceparent``
+  propagation, deterministic trace ids), tail-based retention (head
+  sampling plus unconditional promotion of SLO-violating and
+  alert-coincident requests), ``/tracez``, Perfetto export on the
+  ``trace.py`` time axis, and the fleet stitcher.  Opt-in via
+  ``DSTPU_REQTRACE=1``.
 
 Launcher integration: ``dstpu --metrics_dir DIR`` injects
 ``DSTPU_METRICS_DIR`` so every rank dumps ``metrics_rank<k>.json`` on
@@ -47,6 +54,7 @@ from . import goodput, memory  # noqa: F401  (need registry+trace above)
 from . import exporter, flightrec  # noqa: F401
 from . import anomaly, attribution  # noqa: F401  (need exporter above)
 from . import fleet  # noqa: F401  (needs registry + anomaly above)
+from . import reqtrace  # noqa: F401  (needs registry + trace above)
 
 # arm the per-rank exit dump when the launcher asked for one
 maybe_install_exit_dump()

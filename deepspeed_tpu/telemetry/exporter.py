@@ -16,7 +16,10 @@ the smallest one that works — a stdlib ``http.server`` thread serving:
   (``telemetry/attribution.py``; ``?capture_ms=N`` for an on-demand
   ``jax.profiler`` device trace);
 - ``/alertz`` — active + recent structured alerts and detector
-  thresholds (``telemetry/anomaly.py``).
+  thresholds (``telemetry/anomaly.py``);
+- ``/tracez`` — retained request traces (``telemetry/reqtrace.py``):
+  the index, ``?trace_id=`` for one trace's span tree, ``?full=1`` for
+  every retained trace with spans (the fleet stitcher's fetch).
 
 Opt-in: ``dstpu --telemetry_port P`` injects ``DSTPU_TELEMETRY_PORT``;
 rank ``k`` serves on ``P + k`` (one process per host, so ports collide
@@ -136,6 +139,10 @@ def _health() -> tuple:
 
 class _Handler(BaseHTTPRequestHandler):
     registry: _registry.Registry = None  # type: ignore[assignment]
+    # request tracer serving /tracez; None = resolve the reqtrace
+    # module singleton at request time (a tracer installed AFTER the
+    # exporter started must still be served)
+    tracer = None
 
     def _send(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)
@@ -187,9 +194,38 @@ class _Handler(BaseHTTPRequestHandler):
                 anomaly.observe()
                 self._send(200, json.dumps(anomaly.status()).encode(),
                            "application/json")
+            elif path == "/tracez":
+                # retained request traces (telemetry/reqtrace.py): the
+                # tail-retention ring's index; ?trace_id= serves one
+                # span tree, ?full=1 everything with spans (what the
+                # fleet stitcher fetches)
+                from urllib.parse import parse_qs, urlparse
+
+                from . import reqtrace
+
+                tracer = self.tracer or reqtrace.get_tracer()
+                q = parse_qs(urlparse(self.path).query)
+                if tracer is None:
+                    self._send(200, json.dumps(
+                        {"enabled": False, "retained": []}).encode(),
+                        "application/json")
+                elif "trace_id" in q:
+                    tr = tracer.get_trace(q["trace_id"][0])
+                    if tr is None:
+                        self._send(404, json.dumps(
+                            {"error": "trace not retained",
+                             "trace_id": q["trace_id"][0]}).encode(),
+                            "application/json")
+                    else:
+                        self._send(200, json.dumps(tr).encode(),
+                                   "application/json")
+                else:
+                    payload = tracer.payload(full="full" in q)
+                    self._send(200, json.dumps(payload).encode(),
+                               "application/json")
             else:
                 self._send(404, b"not found: try /metrics /healthz /statusz"
-                                b" /profilez /alertz\n",
+                                b" /profilez /alertz /tracez\n",
                            "text/plain")
         except BrokenPipeError:
             pass                     # scraper went away mid-response
@@ -207,11 +243,16 @@ class TelemetryExporter:
     """One daemon HTTP server thread over the (default) registry."""
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
-                 registry: Optional[_registry.Registry] = None):
+                 registry: Optional[_registry.Registry] = None,
+                 tracer=None):
         self._requested_port = int(port)
         self.host = host if host is not None else \
             os.environ.get(TELEMETRY_HOST_ENV, "127.0.0.1")
         self.registry = registry or _registry.get_registry()
+        # /tracez source; None = the reqtrace module singleton at
+        # request time.  Explicit tracers exist for multi-exporter
+        # emulation in one process (the fleet stitch tests).
+        self.tracer = tracer
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -227,7 +268,7 @@ class TelemetryExporter:
         if self._server is not None:
             return self
         handler = type("_BoundHandler", (_Handler,),
-                       {"registry": self.registry})
+                       {"registry": self.registry, "tracer": self.tracer})
         self._server = ThreadingHTTPServer(
             (self.host, self._requested_port), handler)
         self._server.daemon_threads = True
@@ -240,7 +281,7 @@ class TelemetryExporter:
             "bound port of this rank's telemetry HTTP server"
         ).set(float(self.port))
         logger.info(f"telemetry exporter serving /metrics /healthz "
-                    f"/statusz /profilez /alertz on {self.url}")
+                    f"/statusz /profilez /alertz /tracez on {self.url}")
         return self
 
     def stop(self) -> None:

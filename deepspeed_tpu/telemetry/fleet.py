@@ -61,6 +61,7 @@ from . import registry as _registry
 __all__ = [
     "parse_prometheus", "merge_metrics", "federate_metrics",
     "histogram_quantile", "family_histogram", "metric_total",
+    "stitch_tracez",
     "ReplicaHealth", "HEALTH_STATES", "FleetView", "FleetServer",
     "resolve_targets", "read_discovery", "FLEET_REPLICAS_ENV",
     "DISCOVERY_FILENAME",
@@ -352,6 +353,59 @@ def federate_metrics(per_replica: Dict[str, dict]
                 fs["labels"] = labels
                 cur["samples"].append(fs)
     return out, issues
+
+
+def stitch_tracez(per_replica: Dict[str, Optional[dict]]) -> dict:
+    """Merge replicas' ``/tracez?full=1`` payloads by trace id — the
+    cross-replica request-trace view (``telemetry/reqtrace.py``).
+
+    Once prefill/decode disaggregate and requests hop replicas, one
+    request's spans land on several exporters under ONE propagated
+    trace id (the ``traceparent`` contract).  This stitches them back
+    together: every span is labeled ``replica=<name>``, spans within a
+    trace sort by their UNIX-mapped start time (each replica's payload
+    carries a ``clock_offset_s`` anchoring its monotonic span clock to
+    wall time — replicas' ``perf_counter`` origins are unrelated, so
+    raw ``t0_s`` values must never be compared across replicas), and
+    the per-replica segments (uid, retention reason, slo_ok) are kept
+    under ``segments``.  Tolerant of ``None``/index-only payloads (a
+    replica with tracing off contributes nothing)."""
+    traces: Dict[str, dict] = {}
+    for rep, payload in per_replica.items():
+        if not payload:
+            continue
+        for tr in payload.get("traces") or []:
+            tid = tr.get("trace_id")
+            if not tid:
+                continue
+            dst = traces.get(tid)
+            if dst is None:
+                dst = traces[tid] = {"trace_id": tid, "replicas": [],
+                                     "segments": [], "spans": []}
+            if rep not in dst["replicas"]:
+                dst["replicas"].append(rep)
+            off = float(tr.get("clock_offset_s") or 0.0)
+            dst["segments"].append({
+                "replica": rep, "uid": tr.get("uid"),
+                "retained": tr.get("retained"), "slo_ok": tr.get("slo_ok"),
+                "n_out": tr.get("n_out"), "ttft_ms": tr.get("ttft_ms"),
+                "tpot_ms": tr.get("tpot_ms"), "t_unix": tr.get("t_unix")})
+            for s in tr.get("spans") or []:
+                span = dict(s)
+                span["replica"] = rep
+                span["t0_unix"] = s["t0_s"] + off
+                span["t1_unix"] = s["t1_s"] + off
+                dst["spans"].append(span)
+    for dst in traces.values():
+        dst["spans"].sort(key=lambda s: s["t0_unix"])
+        dst["cross_replica"] = len(dst["replicas"]) > 1
+    order = sorted(traces.values(),
+                   key=lambda t: max((s.get("t_unix") or 0.0
+                                      for s in t["segments"]), default=0.0),
+                   reverse=True)
+    return {"traces": order,
+            "n_traces": len(order),
+            "n_cross_replica": sum(1 for t in order if t["cross_replica"])}
 
 
 def histogram_quantile(sample: dict, q: float) -> Optional[float]:
@@ -897,6 +951,45 @@ class FleetView:
         with self._lock:
             return self._total_queue_locked()
 
+    # -- cross-replica request traces ----------------------------------
+    def fetch_tracez(self) -> Dict[str, Optional[dict]]:
+        """Fetch ``/tracez?full=1`` from every non-down replica (on
+        demand, NOT in the background scrape loop — span payloads are
+        orders of magnitude bigger than a metrics scrape and only a
+        tail-latency investigation needs them).  Fetches run
+        CONCURRENTLY over the same bounded-pool pattern as
+        ``scrape_once``: one blackholed host costing a full
+        ``timeout_s`` must not stall the fleet ``/tracez`` response by
+        N × timeout — the outage window is exactly when the stitched
+        view is wanted."""
+        with self._lock:
+            reps = [(r.name, r.target) for r in self._reps.values()
+                    if r.health.state != "down"]
+
+        def fetch_one(target: str) -> Optional[dict]:
+            try:
+                code, body = self._fetch(target, "/tracez?full=1")
+                return json.loads(body.decode()) if code == 200 else None
+            except Exception:
+                return None
+
+        if len(reps) <= 1:
+            return {name: fetch_one(target) for name, target in reps}
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(reps)),
+                                thread_name_prefix="dstpu-tracez") as pool:
+            futs = {name: pool.submit(fetch_one, target)
+                    for name, target in reps}
+            return {name: f.result() for name, f in futs.items()}
+
+    def stitched_traces(self) -> dict:
+        """The fleet ``/tracez`` payload: every replica's retained
+        traces merged by trace id (see :func:`stitch_tracez`) — a
+        request that hopped replicas under one propagated
+        ``traceparent`` reads as a single span timeline."""
+        return stitch_tracez(self.fetch_tracez())
+
     # -- merged views ---------------------------------------------------
     def _per_replica_metrics(self) -> Dict[str, dict]:
         with self._lock:
@@ -956,10 +1049,13 @@ class FleetView:
                    else round(met / (met + viol), 6)}
         ttft_h = family_histogram(merged.get("serving_ttft_seconds"))
         tpot_h = family_histogram(merged.get("serving_tpot_ms"))
+        qwait_h = family_histogram(merged.get("serving_queue_wait_ms"))
         ttft_p99 = None if ttft_h is None else histogram_quantile(
             ttft_h, 0.99)
         tpot_p99 = None if tpot_h is None else histogram_quantile(
             tpot_h, 0.99)
+        qwait_p99 = None if qwait_h is None else histogram_quantile(
+            qwait_h, 0.99)
         states = {s: sum(1 for r in rows if r.state == s)
                   for s in HEALTH_STATES}
         # fleet goodput: wall-weighted mean of per-replica ratios when
@@ -989,6 +1085,10 @@ class FleetView:
                 else round(ttft_p99 * 1e3, 3),
                 "tpot_p99_ms": None if tpot_p99 is None
                 else round(tpot_p99, 3),
+                # fleet-wide queue wait off the merged serving_queue_wait_ms
+                # histogram: admission pressure a router can actually see
+                "queue_wait_p99_ms": None if qwait_p99 is None
+                else round(qwait_p99, 3),
                 "counters": counters,
                 "gauges": gauges,
             },
@@ -1035,9 +1135,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
                                  for s in HEALTH_STATES}}
                 self._send(200, json.dumps(payload).encode(),
                            "application/json")
+            elif path == "/tracez":
+                # cross-replica request traces, stitched by trace id
+                self._send(200,
+                           json.dumps(self.view.stitched_traces()).encode(),
+                           "application/json")
             else:
                 self._send(404, b"not found: try /fleetz /metrics "
-                                b"/healthz\n", "text/plain")
+                                b"/healthz /tracez\n", "text/plain")
         except BrokenPipeError:
             pass
         except Exception as e:      # a scrape must never kill the plane
@@ -1052,7 +1157,8 @@ class _FleetHandler(BaseHTTPRequestHandler):
 
 class FleetServer:
     """HTTP server over a :class:`FleetView`: ``/fleetz`` (the table),
-    ``/metrics`` (federated), ``/healthz`` (aggregator liveness)."""
+    ``/metrics`` (federated), ``/healthz`` (aggregator liveness),
+    ``/tracez`` (cross-replica request traces stitched by trace id)."""
 
     def __init__(self, view: FleetView, port: int = 0,
                  host: str = "127.0.0.1"):
@@ -1083,7 +1189,7 @@ class FleetServer:
             daemon=True)
         self._thread.start()
         logger.info(f"fleet aggregator serving /fleetz /metrics /healthz "
-                    f"on {self.url}")
+                    f"/tracez on {self.url}")
         return self
 
     def stop(self) -> None:

@@ -181,6 +181,19 @@ class FlightRecorder:
                     payload["attribution"] = snap
             except Exception:
                 pass
+            # retained request traces (telemetry/reqtrace.py): the
+            # tail-retention index (promoted SLO-violating / alert-
+            # coincident summaries first) rides the dump, so a crash
+            # mid-load names not just WHICH uids were in flight but
+            # what each outlier's span walls looked like
+            try:
+                from . import reqtrace as _reqtrace
+
+                idx = _reqtrace.flight_index()
+                if idx:
+                    payload["reqtrace"] = idx
+            except Exception:
+                pass
             if exc is not None:
                 payload["exception"] = {
                     "type": type(exc).__name__,
@@ -367,6 +380,23 @@ def pretty(path_or_payload, max_spans: int = 8, max_logs: int = 8) -> str:
                 break
     if in_flight:
         lines.append(f"  in-flight request uids at last mark: {in_flight}")
+    # retained request traces: the SLO-violating (or alert-coincident)
+    # outliers with their per-phase span walls — "why was this one
+    # slow" without leaving the postmortem
+    viol = [s for s in (p.get("reqtrace") or {}).get("retained", [])
+            if s.get("retained") in ("slo_violation", "alert")]
+    if viol:
+        lines.append(f"  retained SLO-violating traces "
+                     f"({len(viol)}, newest first):")
+        for s in viol[:4]:
+            walls = " ".join(f"{k}={v}ms" for k, v in
+                             (s.get("span_walls_ms") or {}).items())
+            tpot = s.get("tpot_ms")
+            lines.append(
+                f"    {s['trace_id'][:12]}… uid={s.get('uid')} "
+                f"[{s.get('retained')}] ttft={s.get('ttft_ms')}ms "
+                f"tpot={'-' if tpot is None else tpot}ms "
+                f"n_out={s.get('n_out')} {walls}")
     # what was firing: active alerts first, then recent transitions —
     # the "was anything alerting when it died" question
     alerts = p.get("alerts") or {}

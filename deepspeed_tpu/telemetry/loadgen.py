@@ -446,12 +446,19 @@ class LoadReport:
             lines.append(f"peak queue depth: {peak}")
         return "\n".join(lines)
 
-    def format_waterfalls(self, limit: int = 8) -> str:
-        """The ``limit`` slowest-TTFT request waterfalls as text bars."""
+    def format_waterfalls(self, limit: int = 8,
+                          links: Optional[Dict[int, str]] = None) -> str:
+        """The ``limit`` slowest-TTFT request waterfalls as text bars.
+
+        ``links`` maps request uid → retained-trace file (written by
+        ``scripts/loadgen.py --trace-out``): each bar then names the
+        Perfetto JSON holding that exact request's span tree, so the
+        slowest-TTFT table IS the index into "why was this one slow"."""
         done = [w for w in self.waterfalls if w.get("ttft_ms") is not None]
         done.sort(key=lambda w: -w["ttft_ms"])
         lines = [f"{'uid':>5} {'queued':>9} {'prefill':>9} {'decode':>9} "
-                 f"{'ttft_ms':>9} {'tpot_ms':>9} {'tok':>5} {'hit':>5} slo"]
+                 f"{'ttft_ms':>9} {'tpot_ms':>9} {'tok':>5} {'hit':>5} slo"
+                 + ("  trace" if links else "")]
         for w in done[:limit]:
             def ms(x):
                 return "-" if x is None else f"{1e3 * x:9.1f}"
@@ -462,7 +469,8 @@ class LoadReport:
                 f"{'-' if tpot is None else format(tpot, '9.2f'):>9} "
                 f"{w.get('n_out', 0):>5} "
                 f"{w.get('prefix_hit_tokens', 0):>5} "
-                f"{'ok' if w.get('slo_ok') else 'VIOL'}")
+                f"{'ok' if w.get('slo_ok') else 'VIOL'}"
+                + (f"  {links.get(w['uid'], '-')}" if links else ""))
         return "\n".join(lines)
 
 

@@ -1,0 +1,632 @@
+"""Request-scoped distributed tracing: per-request span trees with
+tail-based retention and a ``/tracez`` surface.
+
+The stack can say *what* is slow (attribution roofline verdicts), *that*
+SLOs burn (anomaly detectors) and *which replica* is hot (``/fleetz``) —
+but not "show me everything that happened to request X".  The lifecycle
+observers (``telemetry/loadgen.py``) emit flat per-request events and the
+Chrome-trace spans (``telemetry/trace.py``) are process-scoped with no
+request identity.  This module is the Dapper-style per-request plane:
+
+- :class:`TraceContext` — a 128-bit trace id + 64-bit span ids,
+  DETERMINISTIC from the request uid (replaying a seeded loadgen trace
+  reproduces the same trace ids), carried across processes in the
+  W3C ``traceparent`` header format
+  (``00-<32 hex trace id>-<16 hex span id>-<01|00>``) — the propagation
+  contract the multi-replica router inherits
+  (``ContinuousBatcher.submit(..., trace_context=...)``).
+- :class:`RequestTracer` — a batcher lifecycle observer
+  (``add_lifecycle_observer``) turning the event stream into a span
+  tree per request: ``request`` (root, submit → retire) with children
+  ``queue_wait`` (submit → prefill start), ``prefill`` (with
+  prefix-cache hit tokens and batch co-members as attributes),
+  ``place`` (first token → slot placement: the parked wait), and one
+  ``decode``/``verify`` span per emit window (token count + tick
+  attributes).  Detached = zero cost (the batcher's observer list is
+  empty and ``_note_lifecycle`` short-circuits); attached, every cost
+  is host-side dict/list work at window boundaries — no new device
+  syncs anywhere near ``step``/``_spec_tick``/``_prefill*``.
+- **Tail-based retention** — head sampling (``DSTPU_REQTRACE_SAMPLE``,
+  default 1-in-16, decided deterministically from the trace id) bounds
+  steady-state memory, but retirement ALWAYS promotes SLO-violating
+  (the retire-time ``slo_ok`` tag) and alert-coincident requests into
+  a separate bounded ring — sampling can never hide exactly the
+  requests a tail-latency investigation needs.  Promoted and sampled
+  traces live in distinct rings so a burst of sampled traffic cannot
+  evict the violations.
+- Export three ways: ``/tracez`` on the per-rank exporter (index of
+  retained traces + per-trace JSON), Perfetto/Chrome-trace JSON
+  (:func:`chrome_trace` — the same event format and time axis as
+  ``trace.py``, so request traces and process spans open in ONE viewer
+  timeline), and the fleet stitcher (``fleet.stitch_tracez`` /
+  ``FleetView.stitched_traces()``) merging spans sharing a trace id
+  across replicas.
+
+Enable per batcher (``RequestTracer(...).attach(batcher)`` /
+:func:`install`) or process-wide via ``DSTPU_REQTRACE=1`` (every
+``ContinuousBatcher`` attaches the module tracer at construction).
+Off by default.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+from . import registry as _registry
+from . import trace as _trace
+
+__all__ = [
+    "TraceContext", "parse_traceparent", "RequestTracer",
+    "chrome_events", "chrome_trace", "save_chrome_trace",
+    "get_tracer", "install", "uninstall", "maybe_attach", "flight_index",
+    "REQTRACE_ENV", "REQTRACE_SAMPLE_ENV", "REQTRACE_RING_ENV",
+    "REQTRACE_SEED_ENV",
+]
+
+REQTRACE_ENV = "DSTPU_REQTRACE"
+REQTRACE_SAMPLE_ENV = "DSTPU_REQTRACE_SAMPLE"
+REQTRACE_RING_ENV = "DSTPU_REQTRACE_RING"
+REQTRACE_SEED_ENV = "DSTPU_REQTRACE_SEED"
+
+_DEFAULT_SAMPLE = 16        # head-sample 1 in N (1 = trace everything)
+_DEFAULT_RING = 256         # retained traces per ring (sampled/promoted)
+_MAX_LIVE = 4096            # in-flight state cap (a lost retire must
+                            # not leak unboundedly)
+
+
+# ----------------------------------------------------------------------
+# trace context + propagation
+# ----------------------------------------------------------------------
+class TraceContext:
+    """128-bit trace id + 64-bit span id (+ optional parent span id),
+    hex-encoded; ``sampled`` is the head-sampling decision, which
+    PROPAGATES (a downstream replica must not re-roll the dice and
+    split the trace)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def from_uid(cls, uid: int, seed=0,
+                 sample: int = _DEFAULT_SAMPLE) -> "TraceContext":
+        """Deterministic context for a locally-submitted request: the
+        trace id, root span id AND the head-sampling decision are pure
+        functions of ``(seed, uid)`` — replaying a seeded loadgen trace
+        reproduces identical ids, so a regression report can name the
+        same trace across runs.  ``seed`` may be any str()-able value;
+        the env-attached tracer defaults to a per-process ``rank:pid``
+        seed so two replicas' independent uid counters can never mint
+        the SAME trace id (the fleet stitcher keys on trace id — a
+        collision would merge two unrelated requests into one fake
+        cross-replica trace)."""
+        d = hashlib.sha256(f"dstpu-reqtrace:{seed}:{uid}".encode()).digest()
+        sampled = sample <= 1 or \
+            int.from_bytes(d[24:28], "big") % max(1, int(sample)) == 0
+        return cls(d[:16].hex(), d[16:24].hex(), None, sampled)
+
+    def child_span_id(self, n: int) -> str:
+        """Deterministic n-th child span id under this context's span."""
+        return hashlib.sha256(
+            f"{self.trace_id}:{self.span_id}:{n}".encode()).digest()[:8].hex()
+
+    def to_traceparent(self) -> str:
+        """W3C ``traceparent`` form: ``00-<trace>-<span>-<flags>``
+        (flag bit 0 = sampled).  THE cross-process propagation format:
+        the item-2 router forwards this string with the request and the
+        receiving replica's spans join the same trace."""
+        return (f"00-{self.trace_id}-{self.span_id}-"
+                f"{'01' if self.sampled else '00'}")
+
+    def to_dict(self) -> dict:
+        return {"traceparent": self.to_traceparent()}
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, TraceContext) and \
+            (self.trace_id, self.span_id, self.parent_id, self.sampled) == \
+            (other.trace_id, other.span_id, other.parent_id, other.sampled)
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_traceparent()!r})"
+
+
+def parse_traceparent(value) -> Optional[TraceContext]:
+    """Parse a ``traceparent`` string (or a ``{"traceparent": ...}``
+    dict, the router's JSON-friendly form) into a context whose
+    ``parent_id`` is the INCOMING span id — spans created here become
+    its children.  Returns None on anything malformed (a bad header
+    must degrade to "new local trace", never break submission)."""
+    if isinstance(value, TraceContext):
+        return value
+    if isinstance(value, dict):
+        value = value.get("traceparent")
+    if not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    # the local root span id is derived deterministically from the
+    # incoming edge, so the same hop replayed yields the same span id
+    local = hashlib.sha256(
+        f"{trace_id}:{span_id}:hop".encode()).digest()[:8].hex()
+    return TraceContext(trace_id, local, parent_id=span_id,
+                        sampled=bool(int(flags, 16) & 1))
+
+
+# ----------------------------------------------------------------------
+# the tracer (a batcher lifecycle observer)
+# ----------------------------------------------------------------------
+class _Live:
+    """In-flight per-request state between submit and retire."""
+
+    __slots__ = ("uid", "ctx", "t_submit", "t_prefill", "t_first",
+                 "t_place", "t_cursor", "spans", "n_children", "pf_attrs")
+
+    def __init__(self, uid: int, ctx: TraceContext, t_submit: float):
+        self.uid = uid
+        self.ctx = ctx
+        self.t_submit = t_submit
+        self.t_prefill: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_place: Optional[float] = None
+        # where the next decode/verify window span starts
+        self.t_cursor: Optional[float] = None
+        self.spans: List[dict] = []
+        self.n_children = 0
+        # prefill_start extras held until first_token closes the span
+        self.pf_attrs: dict = {}
+
+
+class RequestTracer:
+    """Per-request span collection + tail-based retention.
+
+    Attach to a batcher with :meth:`attach` (one tracer per batcher —
+    request uids are only unique within a batcher).  Thread-safe: the
+    serving thread appends, ``/tracez`` scrapes snapshot under the same
+    lock."""
+
+    def __init__(self, sample: int = _DEFAULT_SAMPLE,
+                 ring: int = _DEFAULT_RING, seed=0,
+                 alert_fn: Optional[Callable[[], List[str]]] = None):
+        self.sample = max(1, int(sample))
+        self.ring = max(1, int(ring))
+        # seed=None → per-process "rank:pid": N replicas with identical
+        # uid counters mint DISTINCT trace ids (a collision would make
+        # the fleet stitcher fuse unrelated requests).  Pass an explicit
+        # seed for reproducible ids (seeded loadgen replays).
+        self.seed = f"{_registry._rank()}:{os.getpid()}" if seed is None \
+            else seed
+        # injectable for tests; the default asks the anomaly engine
+        # which rules are firing at retirement (alert-coincident
+        # requests are promoted even when unsampled)
+        self._alert_fn = alert_fn
+        self._lock = threading.Lock()
+        self._live: "Dict[int, _Live]" = {}
+        # two rings: head-sampled traces churn with traffic, promoted
+        # (SLO-violating / alert-coincident) traces must survive that
+        # churn — one shared ring would let 256 sampled requests evict
+        # the one violation the investigation needs
+        self._sampled: deque = deque(maxlen=self.ring)
+        self._promoted: deque = deque(maxlen=self.ring)
+        self._removers: List[Callable[[], None]] = []
+        self._m_traced = _registry.counter(
+            "reqtrace_requests_traced_total",
+            "requests whose lifecycle was observed by the request tracer")
+        self._m_retained = _registry.counter(
+            "reqtrace_retained_total",
+            "request traces retained at retirement, by retention reason",
+            labelnames=("reason",))
+        self._m_dropped = _registry.counter(
+            "reqtrace_dropped_total",
+            "request traces dropped at retirement (unsampled, SLO met, "
+            "no coincident alert)")
+        self._m_ring = _registry.gauge(
+            "reqtrace_retained_traces",
+            "retained request traces currently held (both rings)")
+
+    # -- batcher wiring -------------------------------------------------
+    def attach(self, batcher) -> Callable[[], None]:
+        """Register as a lifecycle observer; returns (and remembers)
+        the remover."""
+        remove = batcher.add_lifecycle_observer(self)
+        self._removers.append(remove)
+        return remove
+
+    def detach(self) -> None:
+        for remove in self._removers:
+            try:
+                remove()
+            except Exception:
+                pass
+        self._removers.clear()
+
+    # -- the observer (called by ContinuousBatcher._note_lifecycle) ----
+    def __call__(self, t: float, uid: int, event: str, extra: dict) -> None:
+        with self._lock:
+            if event == "submit":
+                self._on_submit(t, uid, extra)
+                return
+            live = self._live.get(uid)
+            if live is None:
+                return            # attached mid-flight: no submit seen
+            if event == "prefill_start":
+                live.t_prefill = t
+                # the prefix-cache outcome and the batch co-members are
+                # the prefill span's attributes — closed at first_token
+                live.pf_attrs = {
+                    "hit_tokens": extra.get("hit_tokens"),
+                    "prefill_tokens": extra.get("prefill_tokens"),
+                    "batch": extra.get("batch"),
+                    "batch_uids": list(extra.get("batch_uids") or ()) or
+                    None,
+                }
+                self._close(live, "queue_wait", live.t_submit, t, {})
+            elif event == "first_token":
+                live.t_first = t
+                live.t_cursor = t
+                if live.t_prefill is not None:
+                    self._close(live, "prefill", live.t_prefill, t,
+                                live.pf_attrs)
+            elif event == "place":
+                live.t_place = t
+                t0 = live.t_first if live.t_first is not None \
+                    else live.t_submit
+                self._close(live, "place", t0, t,
+                            {"slot": extra.get("slot")})
+                live.t_cursor = t
+            elif event == "emit":
+                t0 = live.t_cursor if live.t_cursor is not None \
+                    else live.t_submit
+                live.t_cursor = t
+                self._close(live, str(extra.get("kind", "decode")), t0, t,
+                            {"tokens": int(extra.get("n", 0)),
+                             "tick": extra.get("tick")})
+            elif event == "retire":
+                self._on_retire(t, uid, live, extra)
+
+    def _on_submit(self, t: float, uid: int, extra: dict) -> None:
+        ctx = None
+        tc = extra.get("trace_context")
+        if tc is not None:
+            ctx = parse_traceparent(tc)
+            if ctx is None:
+                logger.warning(
+                    f"reqtrace: malformed trace_context for uid {uid}: "
+                    f"{tc!r}; starting a fresh local trace")
+        if ctx is None:
+            ctx = TraceContext.from_uid(uid, seed=self.seed,
+                                        sample=self.sample)
+        if len(self._live) >= _MAX_LIVE:
+            # a request whose retire we never see (observer removed and
+            # re-added mid-flight) must not leak state forever
+            self._live.pop(next(iter(self._live)))
+        self._live[uid] = _Live(uid, ctx, t)
+        self._m_traced.inc()
+
+    def _close(self, live: _Live, name: str, t0: float, t1: float,
+               attrs: dict) -> None:
+        live.n_children += 1
+        span = {
+            "trace_id": live.ctx.trace_id,
+            "span_id": live.ctx.child_span_id(live.n_children),
+            "parent_id": live.ctx.span_id,
+            "name": name,
+            "t0_s": t0,
+            "t1_s": t1,
+            "attrs": {k: v for k, v in attrs.items() if v is not None},
+        }
+        live.spans.append(span)
+
+    def _active_alerts(self) -> List[str]:
+        if self._alert_fn is not None:
+            try:
+                return list(self._alert_fn())
+            except Exception:
+                return []
+        try:
+            from . import anomaly as _anomaly
+
+            return sorted({a.get("rule", "?")
+                           for a in _anomaly.get_engine().active().values()})
+        except Exception:
+            return []
+
+    def _on_retire(self, t: float, uid: int, live: _Live,
+                   extra: dict) -> None:
+        self._live.pop(uid, None)
+        slo_ok = extra.get("slo_ok")
+        alerts = self._active_alerts()
+        if slo_ok is False:
+            reason = "slo_violation"
+        elif alerts:
+            reason = "alert"
+        elif live.ctx.sampled:
+            reason = "sampled"
+        else:
+            self._m_dropped.inc()
+            return
+        root = {
+            "trace_id": live.ctx.trace_id,
+            "span_id": live.ctx.span_id,
+            "parent_id": live.ctx.parent_id,
+            "name": "request",
+            "t0_s": live.t_submit,
+            "t1_s": t,
+            "attrs": {k: extra.get(k) for k in
+                      ("n_out", "ttft_ms", "tpot_ms", "slo_ok")
+                      if extra.get(k) is not None},
+        }
+        now_unix = time.time()
+        payload = {
+            "trace_id": live.ctx.trace_id,
+            "uid": uid,
+            "traceparent": live.ctx.to_traceparent(),
+            "retained": reason,
+            "slo_ok": slo_ok,
+            "n_out": extra.get("n_out"),
+            "ttft_ms": extra.get("ttft_ms"),
+            "tpot_ms": extra.get("tpot_ms"),
+            "alerts": alerts,
+            "t_unix": now_unix,
+            "rank": _registry._rank(),
+            "pid": os.getpid(),
+            # map span perf_counter seconds onto the unix axis: the
+            # fleet stitcher aligns spans from replicas whose
+            # perf_counter origins are unrelated
+            "clock_offset_s": now_unix - t,
+            "spans": [root] + live.spans,
+        }
+        (self._promoted if reason != "sampled" else
+         self._sampled).append(payload)
+        self._m_retained.labels(reason=reason).inc()
+        self._m_ring.set(float(len(self._sampled) + len(self._promoted)))
+
+    # -- read side ------------------------------------------------------
+    @staticmethod
+    def _summary(tr: dict) -> dict:
+        walls: Dict[str, float] = {}
+        for s in tr["spans"]:
+            if s["name"] == "request":
+                continue
+            walls[s["name"]] = round(
+                walls.get(s["name"], 0.0)
+                + (s["t1_s"] - s["t0_s"]) * 1e3, 3)
+        return {
+            "trace_id": tr["trace_id"], "uid": tr["uid"],
+            "retained": tr["retained"], "slo_ok": tr["slo_ok"],
+            "n_out": tr["n_out"], "ttft_ms": tr["ttft_ms"],
+            "tpot_ms": tr["tpot_ms"], "t_unix": tr["t_unix"],
+            "alerts": tr.get("alerts") or [],
+            "span_walls_ms": walls,
+            "n_spans": len(tr["spans"]),
+        }
+
+    def _all_retained(self) -> List[dict]:
+        """Promoted first (the traces an investigation needs), then
+        sampled — both newest-first."""
+        return list(reversed(self._promoted)) + list(reversed(self._sampled))
+
+    def index(self) -> dict:
+        """The ``/tracez`` index: summaries of every retained trace."""
+        with self._lock:
+            retained = self._all_retained()
+            live = len(self._live)
+        return {
+            "enabled": True,
+            "sample": self.sample,
+            "ring": self.ring,
+            "live": live,
+            "promoted": sum(1 for t in retained
+                            if t["retained"] != "sampled"),
+            "retained": [self._summary(t) for t in retained],
+        }
+
+    def payload(self, full: bool = False) -> dict:
+        """``/tracez`` body: the index, plus every retained trace's full
+        span list under ``traces`` when ``full`` (the fleet stitcher's
+        fetch)."""
+        out = self.index()
+        if full:
+            with self._lock:
+                out["traces"] = [dict(t) for t in self._all_retained()]
+        return out
+
+    def get_trace(self, trace_id: str) -> Optional[dict]:
+        """Full payload for one retained trace (newest match wins —
+        a cross-replica hop may retire twice under one id locally only
+        when uids collide, which :meth:`attach` scoping prevents)."""
+        with self._lock:
+            for tr in self._all_retained():
+                if tr["trace_id"] == trace_id:
+                    return dict(tr)
+        return None
+
+    def traces(self) -> List[dict]:
+        with self._lock:
+            return [dict(t) for t in self._all_retained()]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._sampled.clear()
+            self._promoted.clear()
+            self._m_ring.set(0.0)
+
+    def _status(self) -> dict:
+        """``/statusz`` ``reqtrace`` section."""
+        with self._lock:
+            return {
+                "sample": self.sample,
+                "ring": self.ring,
+                "live": len(self._live),
+                "retained_sampled": len(self._sampled),
+                "retained_promoted": len(self._promoted),
+            }
+
+
+# ----------------------------------------------------------------------
+# Perfetto / Chrome-trace export (trace.py's event format + time axis)
+# ----------------------------------------------------------------------
+def chrome_events(tr: dict) -> List[dict]:
+    """One retained trace's spans as Chrome-trace ``X`` events on the
+    SAME microsecond axis ``trace.py`` writes (``perf_to_trace_us``), so
+    a request trace and the process span file (``DSTPU_TRACE``) load
+    into one Perfetto timeline.  The request uid is the ``tid`` — each
+    request renders as its own named track."""
+    pid = tr.get("pid", os.getpid())
+    tid = int(tr.get("uid", 0))
+    events: List[dict] = [{
+        "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+        "args": {"name": f"req uid={tr.get('uid')} "
+                         f"trace={tr['trace_id'][:12]}"},
+    }]
+    for s in tr["spans"]:
+        events.append({
+            "name": s["name"], "ph": "X",
+            "ts": _trace.perf_to_trace_us(s["t0_s"]),
+            "dur": max(0.0, (s["t1_s"] - s["t0_s"]) * 1e6),
+            "pid": pid, "tid": tid,
+            "args": {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                     "parent_id": s["parent_id"], "uid": tr.get("uid"),
+                     **(s.get("attrs") or {})},
+        })
+    return events
+
+
+def chrome_trace(traces) -> dict:
+    """Chrome-trace JSON object (the ``traceEvents`` wrapper form, same
+    as ``trace.to_json()``) for one retained trace dict or a list of
+    them."""
+    if isinstance(traces, dict):
+        traces = [traces]
+    events: List[dict] = []
+    for tr in traces:
+        events.extend(chrome_events(tr))
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def save_chrome_trace(path: str, traces) -> str:
+    """Write ``chrome_trace(traces)`` to ``path`` (atomic rename);
+    loadable in ``ui.perfetto.dev`` / ``chrome://tracing`` as-is."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(chrome_trace(traces), fh)
+    os.replace(tmp, path)
+    return path
+
+
+# ----------------------------------------------------------------------
+# module singleton + env wiring
+# ----------------------------------------------------------------------
+_tracer: Optional[RequestTracer] = None
+
+
+def get_tracer() -> Optional[RequestTracer]:
+    return _tracer
+
+
+def install(batcher=None, **kwargs) -> RequestTracer:
+    """Create (or replace) the module tracer — the instance ``/tracez``
+    and the flight dump read by default — and attach it to ``batcher``
+    when given."""
+    global _tracer
+    if _tracer is not None:
+        _tracer.detach()
+    _tracer = RequestTracer(**kwargs)
+    if batcher is not None:
+        _tracer.attach(batcher)
+    from . import exporter as _exporter
+
+    _exporter.register_status_owner("reqtrace", _tracer, "_status")
+    return _tracer
+
+
+def uninstall() -> None:
+    global _tracer
+    if _tracer is not None:
+        _tracer.detach()
+    _tracer = None
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning(f"reqtrace: ignoring non-integer {name}={raw!r}")
+        return default
+
+
+def maybe_attach(batcher) -> Optional[RequestTracer]:
+    """Attach the env-configured module tracer to a new batcher.
+
+    Called from ``ContinuousBatcher.__init__``; a no-op (None) unless
+    ``DSTPU_REQTRACE=1`` — the default-off contract: no observer is
+    registered, so the serving paths' ``_note_lifecycle`` short-circuit
+    keeps the hot loop cost at one truthiness check.
+
+    The module tracer follows the NEWEST batcher: uids are only unique
+    within one batcher, so feeding the tracer from two at once would
+    let batcher B's uid 0 overwrite batcher A's in-flight state and
+    produce span trees mixing two requests (the one-tracer-per-batcher
+    invariant).  A rebuilt engine+batcher (the bench ``_retry``
+    pattern) therefore hands tracing over cleanly; run explicit
+    ``RequestTracer().attach(...)`` instances for genuinely concurrent
+    pools.  The tracer seed defaults to per-process ``rank:pid``
+    (``DSTPU_REQTRACE_SEED`` overrides for reproducible ids)."""
+    if os.environ.get(REQTRACE_ENV, "") in ("", "0"):
+        return None
+    global _tracer
+    if _tracer is None:
+        seed_env = os.environ.get(REQTRACE_SEED_ENV)
+        install(sample=_env_int(REQTRACE_SAMPLE_ENV, _DEFAULT_SAMPLE),
+                ring=_env_int(REQTRACE_RING_ENV, _DEFAULT_RING),
+                seed=seed_env if seed_env else None)
+    else:
+        _tracer.detach()
+    _tracer.attach(batcher)
+    return _tracer
+
+
+def flight_index(max_promoted: int = 16) -> Optional[dict]:
+    """The flight dump's ``reqtrace`` entry: the retained-trace index
+    with the promoted (SLO-violating / alert-coincident) summaries
+    capped — forensics wants the tail, not the whole ring.  None when
+    no tracer is installed or nothing was retained."""
+    t = _tracer
+    if t is None:
+        return None
+    idx = t.index()
+    if not idx["retained"]:
+        return None
+    promoted = [s for s in idx["retained"] if s["retained"] != "sampled"]
+    idx["retained"] = promoted[:max_promoted] + \
+        [s for s in idx["retained"]
+         if s["retained"] == "sampled"][:max_promoted]
+    return idx

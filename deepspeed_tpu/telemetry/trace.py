@@ -31,7 +31,7 @@ from typing import Optional
 
 __all__ = ["span", "device_span", "enable", "disable", "enabled", "clear",
            "save", "to_json", "add_span_observer", "remove_span_observer",
-           "TRACE_ENV", "TRACE_JAX_ENV"]
+           "perf_to_trace_us", "TRACE_ENV", "TRACE_JAX_ENV"]
 
 TRACE_ENV = "DSTPU_TRACE"
 TRACE_JAX_ENV = "DSTPU_TRACE_JAX"
@@ -150,6 +150,16 @@ class span:
                 return fn(*a, **kw)
 
         return wrapped
+
+
+def perf_to_trace_us(t_s: float) -> float:
+    """Map a ``time.perf_counter()`` timestamp (seconds) onto this
+    tracer's Chrome-trace microsecond axis.  The request tracer
+    (``telemetry/reqtrace.py``) collects lifecycle timestamps from
+    ``perf_counter`` and renders them through this helper, so retained
+    request traces and the process span file share ONE Perfetto
+    timeline."""
+    return (t_s * 1e9 - _tracer.t0_ns) / 1e3
 
 
 def device_span(name: str):

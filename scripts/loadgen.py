@@ -26,6 +26,11 @@ Modes:
   JAX_PLATFORMS=cpu python scripts/loadgen.py \
       --record-baseline SERVE_LOAD_BASELINE.json
 
+  # per-request traces: retain every measured request's span tree and
+  # write Perfetto JSONs; the slowest-TTFT waterfall links each bar to
+  # its trace file (open in ui.perfetto.dev)
+  JAX_PLATFORMS=cpu python scripts/loadgen.py --seed 0 --trace-out traces/
+
 The SLO bounds are machine-relative by default (``calibrate_slo``:
 k× the box's own unloaded TTFT/TPOT), so the gate is portable across
 runner speeds; pass --slo-ttft-ms/--slo-tpot-ms for absolute bounds.
@@ -75,6 +80,16 @@ def parse_args(argv=None):
                     help="slowest-TTFT waterfall rows to print")
     ap.add_argument("--report", default=None,
                     help="write the full JSON report here")
+    ap.add_argument("--trace-out", default=None, metavar="DIR",
+                    help="retain per-request traces during the measured "
+                         "passes (telemetry/reqtrace.py) and write each "
+                         "as Perfetto/Chrome-trace JSON under DIR; the "
+                         "slowest-TTFT waterfall links each bar to its "
+                         "trace file")
+    ap.add_argument("--trace-sample", type=int, default=1,
+                    help="head-sampling rate for --trace-out (1-in-N; "
+                         "default 1 = retain every request, so every "
+                         "waterfall bar has a trace)")
     ap.add_argument("--emit-trace", action="store_true",
                     help="print the trace JSON and exit (determinism "
                          "check: identical bytes for identical seeds)")
@@ -130,10 +145,13 @@ _CALIBRATION = {"prompt_len": 8, "max_new": 6, "runs": 3,
 
 def run_load(args, trace_cfg, calibration=None):
     """Warm thoroughly, calibrate (or take absolute bounds), replay
-    ``--passes`` times; returns (best_report, all_reports, slo).
-    ``calibration`` overrides ``_CALIBRATION`` (gate mode passes the
-    baseline's embedded dict so the gate always judges with the SAME
-    SLO scaling the floors were recorded against)."""
+    ``--passes`` times; returns (best_report, all_reports, slo,
+    tracer).  ``calibration`` overrides ``_CALIBRATION`` (gate mode
+    passes the baseline's embedded dict so the gate always judges with
+    the SAME SLO scaling the floors were recorded against).  ``tracer``
+    is the request tracer attached for ``--trace-out`` (None
+    otherwise) — attached AFTER warmup/calibration, so retained traces
+    cover exactly the measured passes."""
     from deepspeed_tpu.telemetry import loadgen
 
     batcher, _ = build_batcher(args)
@@ -163,13 +181,49 @@ def run_load(args, trace_cfg, calibration=None):
             else args.slo_ttft_ms,
             tpot_ms=cal.tpot_ms if args.slo_tpot_ms is None
             else args.slo_tpot_ms)
+    tracer = None
+    if getattr(args, "trace_out", None):
+        from deepspeed_tpu.telemetry import reqtrace
+
+        tracer = reqtrace.RequestTracer(
+            sample=max(1, getattr(args, "trace_sample", 1)),
+            ring=max(256, 2 * args.n_requests * max(1, args.passes)))
+        tracer.attach(batcher)
     reports = [loadgen.replay(batcher, trace, slo, ticks=args.ticks,
                               time_scale=args.time_scale)
                for _ in range(max(1, args.passes))]
+    if tracer is not None:
+        tracer.detach()
     best = max(reports,
                key=lambda r: (r.goodput["slo_attainment"] or 0.0,
                               r.goodput["goodput_tok_s"]))
-    return best, reports, slo
+    return best, reports, slo, tracer
+
+
+def write_traces(out_dir, tracer):
+    """Write every retained request trace as Perfetto/Chrome-trace JSON
+    (one file per trace, the same event format/time axis as
+    ``DSTPU_TRACE`` process spans) plus an ``index.json``; returns
+    {uid: file path} for the waterfall links."""
+    from deepspeed_tpu.telemetry import reqtrace
+
+    os.makedirs(out_dir, exist_ok=True)
+    links = {}
+    for tr in tracer.traces():
+        name = f"reqtrace_uid{tr['uid']}_{tr['trace_id'][:12]}.json"
+        path = os.path.join(out_dir, name)
+        reqtrace.save_chrome_trace(path, tr)
+        # first (newest) retention wins: passes re-submit the same
+        # workload under fresh uids, so collisions only happen across
+        # tracer reuse — keep the newest
+        links.setdefault(tr["uid"], path)
+    index_path = os.path.join(out_dir, "index.json")
+    with open(index_path, "w") as fh:
+        json.dump({"files": {str(u): p for u, p in links.items()},
+                   **tracer.index()}, fh, indent=1)
+    print(f"retained request traces: {len(links)} files under {out_dir} "
+          f"(index: {index_path})")
+    return links
 
 
 def write_report(path, report, args):
@@ -217,9 +271,12 @@ def main(argv=None) -> int:
                   f"generator or config drifted; re-record deliberately",
                   file=sys.stderr)
             return 1
-        best, reports, slo = run_load(
+        best, reports, slo, tracer = run_load(
             args, trace_cfg, calibration=baseline.get("calibration"))
         print(best.table())
+        if args.trace_out and tracer is not None:
+            links = write_traces(args.trace_out, tracer)
+            print(best.format_waterfalls(args.waterfalls, links=links))
         report_json = best.to_jsonable()
         if args.report:
             report_json = write_report(args.report, best, args)
@@ -234,10 +291,13 @@ def main(argv=None) -> int:
         return 0 if ok else 1
 
     cfg = trace_config(args, loadgen, vocab_size=512)
-    best, reports, slo = run_load(args, cfg)
+    best, reports, slo, tracer = run_load(args, cfg)
     print(best.table())
     print()
-    print(best.format_waterfalls(args.waterfalls))
+    links = None
+    if args.trace_out and tracer is not None:
+        links = write_traces(args.trace_out, tracer)
+    print(best.format_waterfalls(args.waterfalls, links=links))
     if args.report:
         write_report(args.report, best, args)
     if args.record_baseline:

@@ -1,0 +1,381 @@
+"""Request-scoped tracing host units (telemetry/reqtrace.py): context
+determinism, traceparent propagation, head sampling, tail-based
+retention (SLO/alert promotion past the sampler), ring bounds,
+Perfetto/Chrome-trace export validity, and the fleet stitcher — all
+synthetic lifecycle events, no batcher, no device work."""
+import json
+
+import pytest
+
+from deepspeed_tpu.telemetry import fleet, registry, reqtrace
+from deepspeed_tpu.telemetry.reqtrace import (RequestTracer, TraceContext,
+                                              parse_traceparent)
+
+
+def _uid_with_sampling(sampled: bool, seed: int = 0,
+                       sample: int = 1000) -> int:
+    """Smallest uid whose deterministic head-sampling decision is
+    ``sampled`` under (seed, sample)."""
+    for uid in range(100_000):
+        if TraceContext.from_uid(uid, seed=seed,
+                                 sample=sample).sampled == sampled:
+            return uid
+    raise AssertionError("no uid found")
+
+
+def _drive(tracer, uid, *, t0=0.0, n_windows=2, tokens_per_window=3,
+           slo_ok=True, ttft_ms=100.0, trace_context=None):
+    """Feed one request's full lifecycle into the tracer observer."""
+    extra = {} if trace_context is None else {"trace_context": trace_context}
+    tracer(t0, uid, "submit", extra)
+    tracer(t0 + 0.1, uid, "prefill_start",
+           {"hit_tokens": 4, "prefill_tokens": 8, "batch": 2,
+            "batch_uids": [uid, uid + 1]})
+    tracer(t0 + 0.2, uid, "first_token", {})
+    tracer(t0 + 0.25, uid, "place", {"slot": 0})
+    t = t0 + 0.25
+    for w in range(n_windows):
+        t += 0.1
+        tracer(t, uid, "emit", {"kind": "decode",
+                                "n": tokens_per_window, "tick": 2 * (w + 1)})
+    n_out = 1 + n_windows * tokens_per_window
+    tracer(t + 0.05, uid, "retire",
+           {"n_out": n_out, "ttft_ms": ttft_ms, "tpot_ms": 12.5,
+            "slo_ok": slo_ok})
+    return n_out
+
+
+# ----------------------------------------------------------------------
+# context + propagation
+# ----------------------------------------------------------------------
+def test_context_deterministic_from_uid_and_seed():
+    a = TraceContext.from_uid(7, seed=3)
+    b = TraceContext.from_uid(7, seed=3)
+    assert a == b
+    assert len(a.trace_id) == 32 and len(a.span_id) == 16
+    int(a.trace_id, 16), int(a.span_id, 16)       # valid hex
+    assert TraceContext.from_uid(8, seed=3).trace_id != a.trace_id
+    assert TraceContext.from_uid(7, seed=4).trace_id != a.trace_id
+    # child span ids: deterministic, distinct per index
+    assert a.child_span_id(1) == b.child_span_id(1)
+    assert a.child_span_id(1) != a.child_span_id(2)
+
+
+def test_traceparent_roundtrip_and_parent_linkage():
+    ctx = TraceContext.from_uid(5, seed=0, sample=1)
+    tp = ctx.to_traceparent()
+    assert tp.startswith("00-") and tp.endswith("-01")
+    hop = parse_traceparent(tp)
+    # the incoming span id becomes the PARENT of the receiving
+    # replica's root; trace id and the sampled flag propagate
+    assert hop.trace_id == ctx.trace_id
+    assert hop.parent_id == ctx.span_id
+    assert hop.span_id != ctx.span_id
+    assert hop.sampled is True
+    # dict form (the router's JSON-friendly carrier)
+    assert parse_traceparent(ctx.to_dict()).trace_id == ctx.trace_id
+    # same hop parsed twice derives the same local span id
+    assert parse_traceparent(tp).span_id == hop.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, 17, "", "garbage", "00-abc-def-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",       # all-zero trace id
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",       # non-hex
+    "00-" + "1" * 32 + "-" + "1" * 16,               # 3 parts
+])
+def test_malformed_traceparent_rejected(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_sampling_decision_deterministic_and_roughly_fractional():
+    n = 2000
+    hits = sum(TraceContext.from_uid(u, seed=0, sample=4).sampled
+               for u in range(n))
+    assert abs(hits / n - 0.25) < 0.05
+    # sample=1 always samples; decision is stable per uid
+    assert all(TraceContext.from_uid(u, seed=0, sample=1).sampled
+               for u in range(32))
+    for u in range(32):
+        assert TraceContext.from_uid(u, seed=0, sample=4).sampled == \
+            TraceContext.from_uid(u, seed=0, sample=4).sampled
+
+
+# ----------------------------------------------------------------------
+# span-tree construction
+# ----------------------------------------------------------------------
+def test_span_tree_from_lifecycle_events():
+    t = RequestTracer(sample=1, ring=8, seed=0, alert_fn=lambda: [])
+    n_out = _drive(t, 0, n_windows=2, tokens_per_window=3)
+    [tr] = t.traces()
+    names = [s["name"] for s in tr["spans"]]
+    assert names == ["request", "queue_wait", "prefill", "place",
+                     "decode", "decode"]
+    root = tr["spans"][0]
+    assert root["parent_id"] is None
+    assert root["attrs"]["n_out"] == n_out
+    # every child parents to the root span; ids unique
+    ids = {s["span_id"] for s in tr["spans"]}
+    assert len(ids) == len(tr["spans"])
+    for s in tr["spans"][1:]:
+        assert s["parent_id"] == root["span_id"]
+        assert root["t0_s"] <= s["t0_s"] <= s["t1_s"] <= root["t1_s"]
+    pf = tr["spans"][2]
+    assert pf["attrs"] == {"hit_tokens": 4, "prefill_tokens": 8,
+                           "batch": 2, "batch_uids": [0, 1]}
+    decode_tokens = sum(s["attrs"]["tokens"] for s in tr["spans"]
+                        if s["name"] == "decode")
+    assert decode_tokens == n_out - 1
+    assert [s["attrs"]["tick"] for s in tr["spans"]
+            if s["name"] == "decode"] == [2, 4]
+    # summary walls add up per phase
+    summ = t.index()["retained"][0]
+    assert summ["span_walls_ms"]["decode"] == pytest.approx(200.0)
+    assert summ["span_walls_ms"]["queue_wait"] == pytest.approx(100.0)
+
+
+def test_events_without_submit_are_ignored():
+    t = RequestTracer(sample=1, ring=4, alert_fn=lambda: [])
+    t(0.0, 9, "emit", {"kind": "decode", "n": 1})
+    t(0.1, 9, "retire", {"n_out": 1, "ttft_ms": 1.0, "slo_ok": True})
+    assert t.traces() == [] and t.index()["live"] == 0
+
+
+# ----------------------------------------------------------------------
+# tail-based retention
+# ----------------------------------------------------------------------
+def test_tail_promotion_retains_violation_at_1_in_1000():
+    t = RequestTracer(sample=1000, ring=8, seed=0, alert_fn=lambda: [])
+    uid = _uid_with_sampling(False, sample=1000)
+    _drive(t, uid, slo_ok=False, ttft_ms=9000.0)
+    [summ] = t.index()["retained"]
+    assert summ["uid"] == uid and summ["retained"] == "slo_violation"
+    assert summ["slo_ok"] is False
+
+
+def test_unsampled_met_request_dropped():
+    t = RequestTracer(sample=1000, ring=8, seed=0, alert_fn=lambda: [])
+    dropped0 = t._m_dropped.value
+    _drive(t, _uid_with_sampling(False, sample=1000), slo_ok=True)
+    assert t.index()["retained"] == []
+    assert t._m_dropped.value == dropped0 + 1
+
+
+def test_alert_coincident_promotion():
+    firing = []
+    t = RequestTracer(sample=1000, ring=8, seed=0,
+                      alert_fn=lambda: list(firing))
+    uid = _uid_with_sampling(False, sample=1000)
+    firing.append("recompile_storm")
+    _drive(t, uid, slo_ok=True)
+    [summ] = t.index()["retained"]
+    assert summ["retained"] == "alert"
+    assert summ["alerts"] == ["recompile_storm"]
+
+
+def test_slo_none_tagging_falls_back_to_sampling():
+    # no SLO configured (slo_ok absent) → only head sampling decides
+    t = RequestTracer(sample=1, ring=8, seed=0, alert_fn=lambda: [])
+    t(0.0, 0, "submit", {})
+    t(0.1, 0, "retire", {"n_out": 1, "ttft_ms": 5.0})
+    assert t.index()["retained"][0]["retained"] == "sampled"
+
+
+def test_ring_bounds_and_promoted_survive_sampled_churn():
+    t = RequestTracer(sample=1, ring=4, seed=0, alert_fn=lambda: [])
+    viol_uid = 10_000
+    _drive(t, viol_uid, slo_ok=False, ttft_ms=9000.0)
+    for uid in range(20):            # 20 sampled traces through a 4-ring
+        _drive(t, uid, t0=float(uid))
+    idx = t.index()
+    assert len(idx["retained"]) == 5          # 4 sampled + 1 promoted
+    assert idx["promoted"] == 1
+    # the violation survived the churn, listed first (promoted ring)
+    assert idx["retained"][0]["uid"] == viol_uid
+    sampled_uids = [s["uid"] for s in idx["retained"][1:]]
+    assert sampled_uids == [19, 18, 17, 16]   # newest-first, bounded
+    assert t._m_ring.value == 5
+
+
+def test_live_state_capped():
+    t = RequestTracer(sample=1, ring=4, alert_fn=lambda: [])
+    for uid in range(reqtrace._MAX_LIVE + 10):
+        t(float(uid), uid, "submit", {})
+    assert t.index()["live"] == reqtrace._MAX_LIVE
+
+
+def test_propagated_context_wins_and_malformed_degrades():
+    t = RequestTracer(sample=1000, ring=8, seed=0, alert_fn=lambda: [])
+    up = TraceContext.from_uid(1, seed=77, sample=1)      # sampled=True
+    uid = _uid_with_sampling(False, sample=1000)          # locally unsampled
+    _drive(t, uid, trace_context=up.to_traceparent())
+    [tr] = t.traces()
+    # joined the upstream trace AND inherited its sampled flag — the
+    # downstream replica must not re-roll the dice and split the trace
+    assert tr["trace_id"] == up.trace_id
+    assert tr["retained"] == "sampled"
+    assert tr["spans"][0]["parent_id"] == up.span_id
+    # malformed context degrades to a fresh local trace
+    t2 = RequestTracer(sample=1, ring=8, seed=0, alert_fn=lambda: [])
+    _drive(t2, 3, trace_context="not-a-traceparent")
+    assert t2.traces()[0]["trace_id"] == \
+        TraceContext.from_uid(3, seed=0).trace_id
+
+
+# ----------------------------------------------------------------------
+# Perfetto / Chrome-trace export
+# ----------------------------------------------------------------------
+def test_chrome_trace_json_validity_and_nesting():
+    t = RequestTracer(sample=1, ring=8, seed=0, alert_fn=lambda: [])
+    _drive(t, 5)
+    [tr] = t.traces()
+    doc = reqtrace.chrome_trace(tr)
+    json.dumps(doc)                       # serializable as-is
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(meta) == 1 and meta[0]["name"] == "thread_name"
+    assert len(xs) == len(tr["spans"])
+    root = xs[0]
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["tid"] == 5 and e["dur"] >= 0
+        assert e["args"]["trace_id"] == tr["trace_id"]
+        # children nest inside the root event's interval
+        assert root["ts"] <= e["ts"]
+        assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-6
+
+
+def test_save_chrome_trace_roundtrip(tmp_path):
+    t = RequestTracer(sample=1, ring=8, seed=0, alert_fn=lambda: [])
+    _drive(t, 0)
+    _drive(t, 1, t0=10.0)
+    path = reqtrace.save_chrome_trace(str(tmp_path / "sub" / "tr.json"),
+                                      t.traces())
+    with open(path) as fh:
+        doc = json.load(fh)
+    # two requests → two named tracks (tids) in one viewer timeline
+    tids = {e["tid"] for e in doc["traceEvents"]}
+    assert tids == {0, 1}
+
+
+# ----------------------------------------------------------------------
+# the fleet stitcher
+# ----------------------------------------------------------------------
+def _payload_for(tracer):
+    return tracer.payload(full=True)
+
+
+def test_stitch_tracez_merges_spans_sharing_trace_id():
+    a = RequestTracer(sample=1, ring=8, seed=0, alert_fn=lambda: [])
+    _drive(a, 0)
+    up = a.traces()[0]
+    b = RequestTracer(sample=1, ring=8, seed=9, alert_fn=lambda: [])
+    _drive(b, 0, trace_context=up["traceparent"])   # the replica hop
+    _drive(b, 1)                                    # unrelated local trace
+    st = fleet.stitch_tracez({"r0": _payload_for(a), "r1": _payload_for(b),
+                              "r2": None})          # tracing-off replica
+    assert st["n_traces"] == 2 and st["n_cross_replica"] == 1
+    merged = next(t for t in st["traces"]
+                  if t["trace_id"] == up["trace_id"])
+    assert merged["cross_replica"] is True
+    assert sorted(merged["replicas"]) == ["r0", "r1"]
+    assert len(merged["segments"]) == 2
+    assert len(merged["spans"]) == len(up["spans"]) * 2
+    for s in merged["spans"]:
+        assert s["replica"] in ("r0", "r1")
+        assert "t0_unix" in s and "t1_unix" in s
+    # spans ordered on the unix-mapped axis (perf origins are unrelated)
+    unix = [s["t0_unix"] for s in merged["spans"]]
+    assert unix == sorted(unix)
+    # index-only payloads (no ?full=1) contribute nothing, never raise
+    st2 = fleet.stitch_tracez({"r0": a.index()})
+    assert st2["n_traces"] == 0
+
+
+# ----------------------------------------------------------------------
+# module wiring: install / maybe_attach / flight_index
+# ----------------------------------------------------------------------
+class _FakeBatcher:
+    def __init__(self):
+        self.observers = []
+
+    def add_lifecycle_observer(self, fn):
+        self.observers.append(fn)
+
+        def remove():
+            self.observers.remove(fn)
+        return remove
+
+
+def test_maybe_attach_env_gate(monkeypatch):
+    b = _FakeBatcher()
+    monkeypatch.delenv(reqtrace.REQTRACE_ENV, raising=False)
+    assert reqtrace.maybe_attach(b) is None
+    assert b.observers == []
+    monkeypatch.setenv(reqtrace.REQTRACE_ENV, "0")
+    assert reqtrace.maybe_attach(b) is None
+    try:
+        monkeypatch.setenv(reqtrace.REQTRACE_ENV, "1")
+        monkeypatch.setenv(reqtrace.REQTRACE_SAMPLE_ENV, "5")
+        t = reqtrace.maybe_attach(b)
+        assert t is not None and t.sample == 5
+        assert len(b.observers) == 1
+        assert reqtrace.get_tracer() is t
+        # the env seed defaults to per-process rank:pid, not a constant
+        # (two replicas' identical uid counters must not collide)
+        assert t.seed != 0
+        # the module tracer FOLLOWS THE NEWEST batcher: uids are only
+        # unique within one, so the old batcher is detached rather than
+        # left feeding uid-colliding events into shared state
+        b2 = _FakeBatcher()
+        assert reqtrace.maybe_attach(b2) is t
+        assert len(b2.observers) == 1
+        assert b.observers == []
+    finally:
+        reqtrace.uninstall()
+    assert reqtrace.get_tracer() is None
+    assert b2.observers == []              # uninstall detached
+
+
+def test_default_process_seed_prevents_cross_replica_collisions():
+    # seed=None (the env-attach default) mixes rank:pid into the hash;
+    # explicit seeds stay byte-reproducible for seeded replays
+    t_proc = reqtrace.RequestTracer(seed=None, alert_fn=lambda: [])
+    assert TraceContext.from_uid(7, seed=t_proc.seed).trace_id != \
+        TraceContext.from_uid(7, seed=0).trace_id
+    assert TraceContext.from_uid(7, seed=t_proc.seed) == \
+        TraceContext.from_uid(7, seed=t_proc.seed)
+
+
+def test_flight_index_promoted_first_and_capped():
+    try:
+        t = reqtrace.install(sample=1, ring=64, seed=0,
+                             alert_fn=lambda: [])
+        assert reqtrace.flight_index() is None       # nothing retained
+        for uid in range(30):
+            _drive(t, uid, t0=float(uid),
+                   slo_ok=(uid % 2 == 0))            # 15 violations
+        idx = reqtrace.flight_index(max_promoted=4)
+        promoted = [s for s in idx["retained"]
+                    if s["retained"] != "sampled"]
+        sampled = [s for s in idx["retained"] if s["retained"] == "sampled"]
+        assert len(promoted) == 4 and len(sampled) == 4
+        assert all(s["slo_ok"] is False for s in promoted)
+        # newest violations first
+        assert promoted[0]["uid"] == 29
+    finally:
+        reqtrace.uninstall()
+
+
+def test_registry_counters_move():
+    reg = registry.get_registry()
+    c = reg.counter("reqtrace_requests_traced_total")
+    r = reg.counter("reqtrace_retained_total", labelnames=("reason",))
+    traced0 = c.total()
+    slo0 = r.labels(reason="slo_violation").value
+    t = RequestTracer(sample=1000, ring=8, seed=0, alert_fn=lambda: [])
+    _drive(t, _uid_with_sampling(False, sample=1000), slo_ok=False)
+    assert c.total() == traced0 + 1
+    assert r.labels(reason="slo_violation").value == slo0 + 1
