@@ -1,0 +1,445 @@
+"""SLO-aware admission control, request deadlines, and load shedding.
+
+The telemetry plane raises signals (``anomaly.subscribe`` is documented
+as the admission-controller seam) but until now nothing *acted* on
+them: under overload the batcher queued unboundedly, and every request
+was served to completion even after it had blown its SLO and was only
+stealing ticks from requests that could still meet theirs.  Production
+continuous-batching systems treat overload behavior as a correctness
+property — the system sheds predictably and degrades gracefully.
+
+:class:`AdmissionController` plugs into
+:class:`~.serving.ContinuousBatcher` (``admission=`` /
+``DSTPU_ADMISSION=1``; resolved None ⇒ every serving path is
+byte-identical to the controller-less batcher) and provides:
+
+- **Bounded admission queue.**  ``max_queue_depth`` caps queued+parked
+  requests.  A full queue sheds the *lowest-priority* request — the
+  arrival, unless a strictly lower-priority request is already queued
+  (that one is evicted and the arrival admitted).  A shed is a
+  first-class ``rejected`` outcome: its own lifecycle event and
+  ``admission_rejected_total{reason}`` counter, never an exception.
+- **Deadline-aware shedding at submit.**  The controller learns the
+  box's own queue-wait-per-depth and prefill walls from lifecycle
+  events (EWMA), estimates the arrival's TTFT at the current depth, and
+  rejects requests that cannot meet their deadline / the configured
+  TTFT SLO — shedding at submit costs nothing; serving a doomed request
+  steals ticks from requests that could still meet their budget.
+- **Per-request deadlines.**  ``submit(deadline_ms=...)`` (or the
+  policy default) bounds submit→retire.  The batcher's deadline sweep
+  retires in-flight slots past their budget (partial output, slot and
+  paged KV freed through the existing retire/donate discipline) and
+  sheds queued requests that expired before ever being admitted.
+- **Degradation ladder** driven by ``anomaly.subscribe``: sustained
+  ``slo_burn``/``queue_runaway`` alerts escalate
+  ``normal → shed_low_priority → cap_tokens → no_specdec`` (each stage
+  includes the previous ones); recovery unwinds in reverse, one stage
+  per sustained all-clear interval.  The alert detectors are already
+  hysteresis state machines, and the ladder adds dwell times of its
+  own, so a flapping signal neither climbs nor unwinds the ladder.
+
+Everything here is host-side bookkeeping at submit/step boundaries —
+no device syncs, nothing on the decode hot path (the DSTPU002
+contract).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+from ..telemetry import registry as telemetry_registry
+from ..utils.logging import logger
+
+__all__ = [
+    "AdmissionPolicy", "AdmissionController", "resolve_admission",
+    "LADDER_STAGES", "ADMISSION_ENV",
+]
+
+ADMISSION_ENV = "DSTPU_ADMISSION"
+
+# the degradation ladder, in escalation order; each stage implies the
+# ones before it (stage 2 sheds low priority AND caps tokens)
+LADDER_STAGES = ("normal", "shed_low_priority", "cap_tokens", "no_specdec")
+
+# alert rules that mean "arrivals are outrunning service" — the only
+# ones that move the ladder (a recompile storm is a bug, not overload)
+_OVERLOAD_RULES = ("slo_burn", "queue_runaway")
+
+
+@dataclasses.dataclass
+class AdmissionPolicy:
+    """Operator knobs.  ``None`` bounds are disabled.
+
+    ``max_queue_depth`` bounds queued+parked requests; ``deadline_ms``
+    is the default submit→retire budget (per-request ``deadline_ms``
+    overrides); ``slo_ttft_ms`` is the submit-time shed bound for the
+    TTFT estimate (falls back to the batcher's ``set_slo`` TTFT bound
+    when None); ``shed_priority_floor`` is the lowest priority class
+    still served at ladder stage >= 1 (requests with priority >= floor
+    shed); ``degraded_max_new_tokens`` caps admitted requests' token
+    budget at stage >= 2; ``ladder_hold_s``/``ladder_recover_s`` are
+    the minimum dwell between escalations / the sustained all-clear
+    required per unwind step; ``est_alpha`` is the estimator EWMA
+    weight."""
+
+    max_queue_depth: int = 64
+    deadline_ms: Optional[float] = None
+    slo_ttft_ms: Optional[float] = None
+    shed_priority_floor: int = 1
+    degraded_max_new_tokens: int = 16
+    ladder_hold_s: float = 3.0
+    ladder_recover_s: float = 10.0
+    est_alpha: float = 0.25
+
+    def to_jsonable(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Estimator:
+    """EWMA model of the box under current load:
+    ``ttft(depth) ≈ prefill_ms + depth * wait_per_depth_ms``.
+
+    Learned from lifecycle events (submit records the depth the request
+    saw; prefill_start yields wait-per-depth; first_token yields the
+    prefill wall).  Returns None until both terms have at least one
+    observation — a controller that has seen no traffic must not shed
+    on a made-up estimate."""
+
+    def __init__(self, alpha: float):
+        self.alpha = float(alpha)
+        self.wait_per_depth_ms: Optional[float] = None
+        self.prefill_ms: Optional[float] = None
+
+    def _ewma(self, prev: Optional[float], x: float) -> float:
+        return x if prev is None else \
+            (1.0 - self.alpha) * prev + self.alpha * x
+
+    def note_wait(self, wait_ms: float, depth_at_submit: int) -> None:
+        self.wait_per_depth_ms = self._ewma(
+            self.wait_per_depth_ms, wait_ms / max(1, depth_at_submit))
+
+    def note_prefill(self, prefill_ms: float) -> None:
+        self.prefill_ms = self._ewma(self.prefill_ms, prefill_ms)
+
+    def estimate_ttft_ms(self, depth: int) -> Optional[float]:
+        if self.wait_per_depth_ms is None or self.prefill_ms is None:
+            return None
+        return self.prefill_ms + depth * self.wait_per_depth_ms
+
+    def to_jsonable(self) -> dict:
+        rnd = (lambda v: None if v is None else round(v, 3))
+        return {"wait_per_depth_ms": rnd(self.wait_per_depth_ms),
+                "prefill_ms": rnd(self.prefill_ms)}
+
+
+class AdmissionController:
+    """One batcher's admission policy + degradation ladder.
+
+    Construct with a policy (or kwargs) and :meth:`attach` to a
+    batcher — ``resolve_admission`` does both when the batcher is built
+    with ``admission=``/``DSTPU_ADMISSION``."""
+
+    def __init__(self, policy: Optional[AdmissionPolicy] = None, *,
+                 anomaly_engine=None, **policy_kw):
+        if policy is None:
+            policy = AdmissionPolicy(**policy_kw)
+        elif policy_kw:
+            raise ValueError("pass either policy= or policy kwargs")
+        self.policy = policy
+        self._anomaly_engine = anomaly_engine
+        self._batcher = None                   # weakref once attached
+        self._lock = threading.Lock()
+        self.est = _Estimator(policy.est_alpha)
+        # uid → absolute perf_counter deadline (the batcher's sweep
+        # reads it; retire/reject pop it)
+        self.deadlines: Dict[int, float] = {}
+        # estimator working state: uid → (t_submit, depth_at_submit),
+        # then uid → t_prefill_start; popped at the next stage so a
+        # shed/lost request can't grow them unboundedly
+        self._sub_info: Dict[int, tuple] = {}
+        self._pf_info: Dict[int, float] = {}
+        # ladder
+        self.stage = 0
+        self._est_min_depth = 1        # attach() raises it to n_slots
+        self._firing: set = set()
+        self._last_move = 0.0                  # last ladder transition
+        self._all_clear_since: Optional[float] = None
+        self._last_eval = 0.0
+        # per-instance tallies (/statusz: registry counters are
+        # process-wide, a second batcher must not report this one's)
+        self._rejected_by_reason: Dict[str, int] = {}
+        self._deadline_expired_n = 0
+        self._last_est_ms: Optional[float] = None
+        self._transitions: List[dict] = []
+        self._unsubscribe = None
+        self._remove_observer = None
+        self._m_rejected = telemetry_registry.counter(
+            "admission_rejected_total",
+            "requests shed at or after admission, by reason",
+            labelnames=("reason",))
+        self._m_deadline = telemetry_registry.counter(
+            "admission_deadline_expired_total",
+            "requests retired/shed past their deadline, by where the "
+            "sweep found them", labelnames=("where",))
+        self._m_stage = telemetry_registry.gauge(
+            "admission_ladder_stage",
+            "degradation ladder stage (0=normal..3=no_specdec)")
+        self._m_transitions = telemetry_registry.counter(
+            "admission_ladder_transitions_total",
+            "ladder moves, by direction", labelnames=("direction",))
+        # no gauge reset here: the registry creates it at 0, and a
+        # second controller's construction must not clobber an active
+        # one's reported stage (the gauge is process-wide and
+        # un-labeled — last TRANSITION wins; per-instance stage lives
+        # in /statusz)
+
+    # -- wiring ---------------------------------------------------------
+    def attach(self, batcher) -> "AdmissionController":
+        """Subscribe to the anomaly seam, observe the batcher's
+        lifecycle events (the estimator's inputs), and publish the
+        ``/statusz`` ``admission`` section."""
+        # the GC callback detaches (unsubscribes from the anomaly
+        # engine, which holds this controller STRONGLY) the moment the
+        # batcher dies — without it a no-alert process would
+        # accumulate one subscribed controller per batcher built,
+        # since the _on_alert dead-check only runs when an alert
+        # actually dispatches (the SIGTERM-hook weakref lesson)
+        self._batcher = weakref.ref(batcher, lambda _r: self.detach())
+        self._est_min_depth = max(1, int(getattr(batcher, "n_slots", 1)))
+        if self._anomaly_engine is None:
+            from ..telemetry import anomaly as anomaly_mod
+
+            self._anomaly_engine = anomaly_mod.get_engine()
+        self._unsubscribe = self._anomaly_engine.subscribe(self._on_alert)
+        # every controller->batcher reference must be WEAK (the anomaly
+        # engine holds the controller strongly until detach): keeping
+        # the batcher's own remover closure would pin batcher -> engine
+        # -> params for process lifetime.  A dead batcher's observer
+        # list dies with it, so the weak remover only has to handle the
+        # live-detach case.
+        batcher.add_lifecycle_observer(self._on_lifecycle)
+        batcher_ref = self._batcher
+        observer = self._on_lifecycle
+
+        def _remove_observer():
+            b = batcher_ref()
+            if b is not None and observer in b._lifecycle_observers:
+                b._lifecycle_observers.remove(observer)
+
+        self._remove_observer = _remove_observer
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_owner(
+            "admission", self, "_telemetry_status")
+        return self
+
+    def detach(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+        if self._remove_observer is not None:
+            self._remove_observer()
+            self._remove_observer = None
+        self._batcher = None
+
+    # -- estimator feed (lifecycle observer) ----------------------------
+    def _on_lifecycle(self, t: float, uid: int, event: str,
+                      extra: dict) -> None:
+        # the submit-side record comes from note_admitted (NOT the
+        # submit lifecycle event): the estimator must learn against
+        # the same queued+parked, pre-insert depth check_submit is
+        # later evaluated with, and the event's ``queued`` extra is
+        # queue-only, post-insert
+        if event == "prefill_start":
+            sub = self._sub_info.pop(uid, None)
+            if sub is not None:
+                self.est.note_wait((t - sub[0]) * 1e3, sub[1])
+            self._pf_info[uid] = t
+        elif event == "first_token":
+            pf = self._pf_info.pop(uid, None)
+            if pf is not None:
+                self.est.note_prefill((t - pf) * 1e3)
+        elif event in ("retire", "rejected"):
+            self._sub_info.pop(uid, None)
+            self._pf_info.pop(uid, None)
+
+    # -- submit-time policy --------------------------------------------
+    def check_submit(self, depth: int, priority: int,
+                     deadline_ms: Optional[float],
+                     slo_ttft_ms: Optional[float] = None
+                     ) -> Optional[str]:
+        """Shed verdict for an arrival seeing ``depth`` queued+parked
+        requests: a rejection-reason string, or None to admit.  The
+        queue-bound check is handled by the caller (it may prefer
+        evicting a lower-priority queued request — see
+        ``ContinuousBatcher.submit``); this covers the class shed and
+        the deadline estimate."""
+        if self.stage >= 1 and priority >= self.policy.shed_priority_floor:
+            return "shed_class"
+        budget = deadline_ms if deadline_ms is not None \
+            else self.policy.deadline_ms
+        ttft_bound = self.policy.slo_ttft_ms \
+            if self.policy.slo_ttft_ms is not None else slo_ttft_ms
+        bounds = [b for b in (budget, ttft_bound) if b is not None]
+        # estimate-shed ONLY with a full wave already in flight (depth
+        # >= n_slots): below that the arrival starts almost
+        # immediately, and — the load-bearing part — admitted requests
+        # keep refreshing the estimator.  Shedding at idle off a
+        # stale-high estimate is a death spiral: nothing admits, so no
+        # observation ever corrects the estimate.
+        if bounds and depth >= self._est_min_depth:
+            est = self.est.estimate_ttft_ms(depth)
+            self._last_est_ms = est
+            if est is not None and est > min(bounds):
+                return "deadline_unmeetable"
+        return None
+
+    def note_rejected(self, reason: str) -> None:
+        self._m_rejected.labels(reason=reason).inc()
+        with self._lock:
+            self._rejected_by_reason[reason] = \
+                self._rejected_by_reason.get(reason, 0) + 1
+
+    def note_admitted(self, uid: int, now: float,
+                      deadline_ms: Optional[float],
+                      depth: int = 0) -> None:
+        """Record an admitted request: its deadline, and the
+        queued+parked depth it saw at submit (the estimator's
+        denominator — the SAME depth basis ``check_submit`` sheds
+        against)."""
+        self._sub_info[uid] = (now, int(depth))
+        budget = deadline_ms if deadline_ms is not None \
+            else self.policy.deadline_ms
+        if budget is not None:
+            self.deadlines[uid] = now + budget / 1e3
+
+    def note_deadline_expired(self, uid: int, where: str) -> None:
+        self.deadlines.pop(uid, None)
+        self._m_deadline.labels(where=where).inc()
+        self._deadline_expired_n += 1
+
+    def cap_max_new(self, max_new: int) -> int:
+        """Ladder stage >= 2: admitted requests' token budget caps at
+        ``degraded_max_new_tokens`` — shorter answers for everyone
+        beats no answers for some."""
+        if self.stage >= 2:
+            return min(max_new, self.policy.degraded_max_new_tokens)
+        return max_new
+
+    def allow_specdec(self) -> bool:
+        """Ladder stage >= 3: speculative decoding pays verify
+        forwards that are pure overhead when acceptance drops under
+        load — plain ticks are the predictable-latency choice."""
+        return self.stage < 3
+
+    # -- the degradation ladder ----------------------------------------
+    def _on_alert(self, ev: dict) -> None:
+        if self._batcher is not None and self._batcher() is None:
+            # the batcher is gone: a dead controller must not keep
+            # riding the alert seam (subscribers are strongly held)
+            self.detach()
+            return
+        rule = ev.get("rule")
+        if rule not in _OVERLOAD_RULES:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if ev.get("state") == "firing":
+                self._firing.add(rule)
+                self._all_clear_since = None
+            else:
+                self._firing.discard(rule)
+                if not self._firing:
+                    self._all_clear_since = now
+        self._evaluate_ladder(now)
+
+    def maybe_step(self) -> None:
+        """Cheap per-``step`` hook: time-based ladder moves (a
+        sustained alert keeps escalating even when no new alert EVENT
+        arrives, and recovery needs wall time to pass).  Throttled to
+        ~1/s."""
+        now = time.monotonic()
+        if now - self._last_eval < 1.0:
+            return
+        self._last_eval = now
+        self._evaluate_ladder(now)
+
+    def _evaluate_ladder(self, now: float) -> None:
+        moved = None
+        with self._lock:
+            if self._firing and self.stage < len(LADDER_STAGES) - 1 \
+                    and now - self._last_move >= self.policy.ladder_hold_s:
+                self.stage += 1
+                self._last_move = now
+                moved = "up"
+            elif not self._firing and self.stage > 0 \
+                    and self._all_clear_since is not None \
+                    and now - max(self._last_move, self._all_clear_since) \
+                    >= self.policy.ladder_recover_s:
+                self.stage -= 1
+                self._last_move = now
+                moved = "down"
+            if moved:
+                self._transitions.append({
+                    "t": time.time(), "direction": moved,
+                    "stage": LADDER_STAGES[self.stage],
+                    "firing": sorted(self._firing)})
+                del self._transitions[:-32]
+        if moved:
+            self._m_stage.set(float(self.stage))
+            self._m_transitions.labels(direction=moved).inc()
+            logger.warning(
+                f"admission ladder {moved}: stage -> "
+                f"{LADDER_STAGES[self.stage]} "
+                f"(firing: {sorted(self._firing)})")
+
+    # -- export ---------------------------------------------------------
+    def _telemetry_status(self) -> dict:
+        with self._lock:
+            return {
+                "stage": LADDER_STAGES[self.stage],
+                "stage_idx": self.stage,
+                "firing": sorted(self._firing),
+                "policy": self.policy.to_jsonable(),
+                "rejected": dict(self._rejected_by_reason),
+                "deadline_expired": self._deadline_expired_n,
+                "deadlines_active": len(self.deadlines),
+                "last_est_ttft_ms": None if self._last_est_ms is None
+                else round(self._last_est_ms, 3),
+                "estimator": self.est.to_jsonable(),
+                "transitions": list(self._transitions[-8:]),
+            }
+
+
+def resolve_admission(engine, override=None) -> Optional[AdmissionController]:
+    """Resolve the batcher's admission mode (the kvreuse/specdec
+    precedence convention): ``DSTPU_ADMISSION=0`` kills even a ready
+    instance; an explicit ``False`` opts out; a ready
+    :class:`AdmissionController` passes through; ``True``/``{}`` enable
+    defaults; a dict carries :class:`AdmissionPolicy` kwargs; unset
+    everything ⇒ None, and every serving path stays byte-identical to
+    the controller-less batcher."""
+    env = os.environ.get(ADMISSION_ENV, "").strip().lower()
+    if env in ("0", "false", "off"):
+        return None
+    cfg = override if override is not None else \
+        getattr(engine.config, "admission", None)
+    if cfg is False:
+        return None
+    if isinstance(cfg, AdmissionController):
+        return cfg
+    if isinstance(cfg, AdmissionPolicy):
+        return AdmissionController(cfg)
+    if isinstance(cfg, dict):
+        try:
+            return AdmissionController(AdmissionPolicy(**cfg))
+        except TypeError as e:
+            logger.warning(f"admission disabled: bad policy {cfg!r}: {e}")
+            return None
+    if cfg is True or (cfg is None and env in ("1", "true", "on")):
+        return AdmissionController()
+    return None

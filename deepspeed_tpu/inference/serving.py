@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -30,10 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import common as model_common
-from ..telemetry import (attribution, goodput, memory as telemetry_memory,
+from ..telemetry import (attribution, flightrec as telemetry_flightrec,
+                         goodput, memory as telemetry_memory,
                          recompile, registry as telemetry_registry,
                          reqtrace as telemetry_reqtrace, trace)
 from ..telemetry.registry import pct as _pct
+from ..testing import chaos as chaos_mod
+from . import admission as admission_mod
 from . import kvreuse
 from . import specdec as specdec_mod
 from .engine import InferenceEngine, _sample
@@ -54,6 +59,12 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     repetition_penalty: float = 1.0
+    # admission-control fields (inference/admission.py): lower number =
+    # higher priority (0 is the default, highest, class); deadline_ms
+    # bounds submit -> retire (None defers to the policy default).
+    # Inert without a resolved AdmissionController.
+    priority: int = 0
+    deadline_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -76,7 +87,7 @@ class ContinuousBatcher:
                  chunked_prefill: bool = True,
                  prefill_ahead: Optional[int] = None,
                  prefix_cache=None, specdec=None, paged_decode=None,
-                 slo=None):
+                 slo=None, admission=None):
         if engine.params is None:
             raise RuntimeError("engine has no parameters loaded")
         self.engine = engine
@@ -110,6 +121,15 @@ class ContinuousBatcher:
         # machinery.
         self.paged = kvreuse.resolve_paged_decode(
             engine, self.prefix_cache, n_slots, self.specdec, paged_decode)
+        # SLO-aware admission control (inference/admission.py): None
+        # when disabled (DSTPU_ADMISSION unset and no admission= /
+        # engine-config entry) — and then submit/step/wait are
+        # byte-for-byte the controller-less batcher
+        self.admission = admission_mod.resolve_admission(engine, admission)
+        # seeded fault injection (testing/chaos.py): resolves the
+        # DSTPU_CHAOS_PLAN env once; with no plan installed every site
+        # is a single attribute load
+        chaos_mod.maybe_install_env()
         cfg = engine.decode_cfg
         self._vocab = int(getattr(cfg, "padded_vocab_size", None)
                           or cfg.vocab_size)
@@ -165,6 +185,19 @@ class ContinuousBatcher:
         self._tick_no = 0
         self._next_uid = 0
         self._finished: Dict[int, np.ndarray] = {}
+        # shed requests: uid -> rejection reason.  A shed is a FIRST-
+        # CLASS outcome (its own lifecycle event + metrics), never an
+        # exception: the caller holds a uid that will never appear in
+        # ``_finished``, and ``wait()``/``run()`` treat it as terminal.
+        # Bounded like the latency window — a long-lived server's
+        # memory stays O(window).
+        self._rejected: Dict[int, str] = {}
+        # requests the deadline sweep retired early — tags the retire
+        # lifecycle event so observers can tell a deadline retirement
+        # from a natural one
+        self._deadline_hits: set = set()
+        self._draining = False
+        self._in_step = False
         # per-request latency bookkeeping (submit → first token → done),
         # the serving-metrics surface production schedulers expose; TTFT
         # here covers queueing + prefill + first sample (reference has no
@@ -558,11 +591,43 @@ class ContinuousBatcher:
         # default — no observer registers, every _note_lifecycle stays
         # one truthiness check (the DSTPU002 zero-cost contract).
         telemetry_reqtrace.maybe_attach(self)
+        if self.admission is not None:
+            self.admission.attach(self)
+        # graceful termination: the launcher's SIGTERM drains in-flight
+        # work (bounded by DSTPU_DRAIN_TIMEOUT_S, default 5s; 0
+        # disables) BEFORE the flight recorder dumps, so the dump
+        # snapshots a drained replica and no request is silently lost
+        # to a rolling restart.  Weakly bound, and the weakref's GC
+        # callback unregisters the hook — a process that builds many
+        # batchers (every test suite) must not grow the module hook
+        # list one dead closure per construction (the reqtrace
+        # observer-leak lesson).  Skipped when the signal lands
+        # mid-step (slot state would be mid-mutation) or mid-drain.
+        hook_remover: list = []
+        ref = weakref.ref(
+            self, lambda _r: hook_remover and hook_remover[0]())
+
+        def _drain_on_term():
+            b = ref()
+            if b is None or b._in_step or b._draining:
+                return
+            try:
+                timeout = float(os.environ.get("DSTPU_DRAIN_TIMEOUT_S",
+                                               "5"))
+            except ValueError:
+                timeout = 5.0
+            if timeout > 0 and b.pending:
+                b.drain(ticks=4, timeout_s=timeout, flush=False)
+
+        self._remove_drain_hook = telemetry_flightrec.add_sigterm_hook(
+            _drain_on_term)
+        hook_remover.append(self._remove_drain_hook)
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, temperature: float = 0.0,
                top_p: float = 1.0, repetition_penalty: float = 1.0,
-               trace_context=None) -> int:
+               trace_context=None, priority: int = 0,
+               deadline_ms: Optional[float] = None) -> int:
         """Queue a request; returns its uid.
 
         ``trace_context`` (a ``traceparent`` string, a ``{"traceparent":
@@ -571,7 +636,17 @@ class ContinuousBatcher:
         multi-replica router uses when forwarding a request, so one
         trace id survives the process hop.  It rides the ``submit``
         lifecycle event; with no observers registered it costs
-        nothing."""
+        nothing.
+
+        With a resolved admission controller (``admission=`` /
+        ``DSTPU_ADMISSION``), the request may be SHED instead of
+        queued: the returned uid then never appears in the finished
+        set, :attr:`rejected` maps it to the rejection reason, and a
+        ``rejected`` lifecycle event + ``admission_rejected_total``
+        fire.  ``priority`` (lower = more important, 0 default) orders
+        the admission queue and picks shed victims; ``deadline_ms``
+        bounds submit→retire (the deadline sweep retires a past-budget
+        request wherever it is — queued, parked, or on a slot)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -588,17 +663,92 @@ class ContinuousBatcher:
         # pages, and PagedServingState's construction floor guarantees
         # the pool holds n_slots of those — a request that passes the
         # gen-limit check always fits
+        if self._draining:
+            return self._reject_submit("draining")
+        adm = self.admission
+        if adm is not None:
+            depth = len(self._queue) + len(self._parked)
+            # class/estimate shed FIRST: an arrival doomed either way
+            # must not evict a queued victim on the way out
+            reason = adm.check_submit(depth, priority, deadline_ms,
+                                      self._slo_ttft_ms)
+            if reason is not None:
+                return self._reject_submit(reason)
+            if depth >= adm.policy.max_queue_depth:
+                # bounded admission queue: shed the LOWEST-priority
+                # request — the arrival, unless a strictly lower-
+                # priority request is already queued (evict that one,
+                # admit this one)
+                victim = None
+                for r in self._queue:
+                    if r.priority > priority and (
+                            victim is None
+                            or r.priority > victim.priority):
+                        victim = r
+                if victim is None:
+                    return self._reject_submit("queue_full")
+                self._queue.remove(victim)
+                self._reject_queued(victim, "queue_full")
+            max_new_tokens = adm.cap_max_new(max_new_tokens)
         uid = self._next_uid
         self._next_uid += 1
-        self._queue.append(Request(uid, prompt, max_new_tokens,
-                                   temperature, top_p, repetition_penalty))
-        self._t_submit[uid] = time.perf_counter()
+        req = Request(uid, prompt, max_new_tokens, temperature, top_p,
+                      repetition_penalty, priority, deadline_ms)
+        # the depth THIS request saw (pre-insert, queued+parked, post-
+        # eviction) — the estimator's learning denominator, same basis
+        # check_submit sheds against
+        depth_seen = len(self._queue) + len(self._parked)
+        if adm is not None:
+            # priority-ordered insertion (stable within a class, FIFO
+            # when every priority matches); the admission-off path
+            # appends unconditionally — the pre-existing behavior
+            pos = next((k for k, r in enumerate(self._queue)
+                        if r.priority > priority), len(self._queue))
+            self._queue.insert(pos, req)
+        else:
+            self._queue.append(req)
+        now = time.perf_counter()
+        self._t_submit[uid] = now
+        if adm is not None:
+            adm.note_admitted(uid, now, deadline_ms, depth=depth_seen)
         self._m_submitted.inc()
         self._note_lifecycle(uid, "submit", queued=len(self._queue),
                              **({"trace_context": trace_context}
                                 if trace_context is not None else {}))
         self._update_occupancy_gauges()
         return uid
+
+    # -- load shedding (inference/admission.py) ------------------------
+    @property
+    def rejected(self) -> Dict[int, str]:
+        """uid → rejection reason for shed requests (bounded window)."""
+        return self._rejected
+
+    def _note_rejected(self, uid: int, reason: str, **extra) -> None:
+        self._rejected[uid] = reason
+        while len(self._rejected) > 8192:     # bounded, like _lat
+            self._rejected.pop(next(iter(self._rejected)))
+        if self.admission is not None:
+            self.admission.note_rejected(reason)
+        self._note_lifecycle(uid, "rejected", reason=reason, **extra)
+
+    def _reject_submit(self, reason: str) -> int:
+        """Shed at submit: the uid is allocated (the caller gets a
+        handle to look up the outcome) but the request never queues."""
+        uid = self._next_uid
+        self._next_uid += 1
+        self._note_rejected(uid, reason,
+                            queued=len(self._queue) + len(self._parked))
+        return uid
+
+    def _reject_queued(self, req: Request, reason: str) -> None:
+        """Shed a request that was admitted but never prefilled (queue
+        eviction / expired-in-queue): same ``rejected`` outcome, plus
+        the submit-side bookkeeping is unwound."""
+        self._t_submit.pop(req.uid, None)
+        if self.admission is not None:
+            self.admission.deadlines.pop(req.uid, None)
+        self._note_rejected(req.uid, reason, where="queued")
 
     @property
     def pending(self) -> int:
@@ -680,6 +830,9 @@ class ContinuousBatcher:
             "prefix_cache": self.prefix_cache is not None,
             "paged_decode": self.paged is not None,
             "specdec": self.specdec is not None,
+            "admission": self.admission is not None,
+            "rejected": len(self._rejected),
+            "draining": self._draining,
             "in_flight_uids": self._active_uids(),
             "tpot_ms": None if not self._tpot_window else round(
                 sum(self._tpot_window) / len(self._tpot_window), 3),
@@ -732,6 +885,14 @@ class ContinuousBatcher:
         arena: without donation every chunk would copy the whole arena
         to apply an O(chunk) append.  The caller must rebind the arena
         from the returned cache (``PagedServingState.adopt``)."""
+        if chaos_mod.maybe_fire("prefill_failure") is not None:
+            # injected BEFORE any chunk dispatch, so no donated buffer
+            # has been consumed — the admission paths' rollback
+            # (contiguous pin/unpin finally, paged abort_admit) runs
+            # against intact device state, exactly like a dispatch-time
+            # device fault
+            raise chaos_mod.ChaosFault(
+                "injected prefill failure (chaos site prefill_failure)")
         eng = self.engine
         prefill_fn = eng._compiled_prefill_donated if donate \
             else eng._compiled_prefill
@@ -896,6 +1057,15 @@ class ContinuousBatcher:
                         ids, cache=cacheB, start=m0,
                         uids=[r.uid for r in reqs])
                     last = logits[:, -1:, :]
+            except chaos_mod.ChaosFault:
+                # transient admission fault (chaos site
+                # prefill_failure): the group returns to the queue head
+                # IN ORDER and retries next step — an injected failure
+                # must never lose requests (the finally below still
+                # unpins the hit chain)
+                self._queue.extendleft(reversed(reqs))
+                self._update_occupancy_gauges()
+                return
             finally:
                 if m0:
                     pc.unpin(nodes0)
@@ -971,12 +1141,19 @@ class ContinuousBatcher:
             admitted, metas = [], []
             while reqs:
                 r = reqs[0]
-                # span covers prompt + generation; bucket-pad overshoot
-                # past it resolves to the table's trash entries
-                meta = pg.try_admit(
-                    r.prompt, r.max_new_tokens, m0, nodes0, pids0,
-                    span_tokens=min(len(r.prompt) + r.max_new_tokens,
-                                    pg.gen_limit))
+                if chaos_mod.maybe_fire("page_pool_exhaustion") is not None:
+                    # injected empty pool: identical to a real
+                    # allocation failure — the backpressure path below
+                    # re-queues the tail in order
+                    meta = None
+                else:
+                    # span covers prompt + generation; bucket-pad
+                    # overshoot past it resolves to the table's trash
+                    # entries
+                    meta = pg.try_admit(
+                        r.prompt, r.max_new_tokens, m0, nodes0, pids0,
+                        span_tokens=min(len(r.prompt) + r.max_new_tokens,
+                                        pg.gen_limit))
                 if meta is None:
                     # out of pages even after eviction: return the tail
                     # to the queue head IN ORDER and stop admitting
@@ -1069,6 +1246,17 @@ class ContinuousBatcher:
                     # _parked_meta
                     self._parked.append(
                         (req, None, row, firstB, seen1B, first_host))
+            except chaos_mod.ChaosFault:
+                # injected prefill failure: run the REAL rollback
+                # (abort_admit frees own pages + unpins the hit chain —
+                # no tree absorb), then re-queue the un-consumed
+                # requests and keep serving; the arena is intact (the
+                # fault fires before any chunk dispatch)
+                for meta in metas[consumed:]:
+                    pg.abort_admit(meta)
+                self._queue.extendleft(reversed(admitted[consumed:]))
+                self._update_occupancy_gauges()
+                return
             except Exception:
                 for meta in metas[consumed:]:
                     pg.abort_admit(meta)
@@ -1090,6 +1278,10 @@ class ContinuousBatcher:
         t_sub = self._t_submit.pop(uid, None)
         t_first = self._t_first.pop(uid, None)
         self._m_completed.inc()
+        deadline_expired = uid in self._deadline_hits
+        self._deadline_hits.discard(uid)
+        if self.admission is not None:
+            self.admission.deadlines.pop(uid, None)
         if t_sub is None:
             return
         now = time.perf_counter()
@@ -1124,12 +1316,64 @@ class ContinuousBatcher:
                              ttft_ms=round(ttft_ms, 3),
                              tpot_ms=None if tpot_ms is None
                              else round(tpot_ms, 4),
-                             slo_ok=slo_ok)
+                             slo_ok=slo_ok,
+                             **({"deadline_expired": True}
+                                if deadline_expired else {}))
 
     def _finish_unslotted(self, req: Request, emitted: List[int]):
         self._finished[req.uid] = np.concatenate(
             [req.prompt, np.asarray(emitted, np.int32)])
         self._record_latency(req.uid, n_out=len(emitted))
+        self._update_occupancy_gauges()
+
+    def _deadline_sweep(self):
+        """Retire/shed every request past its deadline, wherever the
+        sweep finds it (runs at step boundaries, host bookkeeping
+        only):
+
+        - **queued** — never admitted, can no longer meet its budget:
+          shed (``rejected`` outcome, reason ``deadline_expired``);
+        - **parked** — its first token exists: finished unslotted with
+          that partial output (paged page ownership released);
+        - **on a slot** — retired with whatever it emitted, freeing the
+          slot and its paged KV through the existing retire/donate
+          discipline, so a long-running request past budget stops
+          stealing ticks from requests that can still meet theirs.
+
+        Slot/parked retirements tag their ``retire`` lifecycle event
+        with ``deadline_expired=True``."""
+        adm = self.admission
+        if adm is None or not adm.deadlines:
+            return
+        now = time.perf_counter()
+        for r in [r for r in self._queue
+                  if adm.deadlines.get(r.uid, now) < now]:
+            self._queue.remove(r)
+            adm.note_deadline_expired(r.uid, "queued")
+            self._reject_queued(r, "deadline_expired")
+        shrunk = False
+        for entry in [e for e in self._parked
+                      if adm.deadlines.get(e[0].uid, now) < now]:
+            req = entry[0]
+            self._parked.remove(entry)
+            shrunk = True
+            adm.note_deadline_expired(req.uid, "parked")
+            self._deadline_hits.add(req.uid)
+            if self.paged is not None:
+                meta = self._parked_meta.pop(req.uid, None)
+                if meta is not None:
+                    self.paged.finish_unslotted(meta, req.prompt)
+            self._finish_unslotted(req, [entry[5]])
+        if shrunk:
+            self._shrink_parked()
+        for i, act in enumerate(self._slots):
+            if act is None:
+                continue
+            dl = adm.deadlines.get(act.req.uid)
+            if dl is not None and dl < now:
+                adm.note_deadline_expired(act.req.uid, "slot")
+                self._deadline_hits.add(act.req.uid)
+                self._retire(i)
         self._update_occupancy_gauges()
 
     def _admit(self):
@@ -1258,8 +1502,23 @@ class ContinuousBatcher:
                 continue
             ctx = np.concatenate([act.req.prompt,
                                   np.asarray(act.emitted, np.int32)])
-            p = np.asarray(spec.drafter.propose(ctx, cap),
-                           np.int32).reshape(-1)[:cap]
+            try:
+                if chaos_mod.maybe_fire("drafter_exception") is not None:
+                    raise chaos_mod.ChaosFault(
+                        "injected drafter failure "
+                        "(chaos site drafter_exception)")
+                p = np.asarray(spec.drafter.propose(ctx, cap),
+                               np.int32).reshape(-1)[:cap]
+            except Exception as e:
+                # a crashing drafter degrades to an empty proposal (the
+                # slot takes plain ticks; all-empty falls back to a
+                # plain window) — drafting is an optimization, never a
+                # correctness dependency the serve loop may die on
+                logger.warning(
+                    f"specdec drafter "
+                    f"{getattr(spec.drafter, 'name', '?')} raised "
+                    f"{e!r}; slot {i} degrades to plain decode")
+                p = np.empty((0,), np.int32)
             bad = (p < 0) | (p >= self._vocab)
             if bad.any():   # a buggy drafter must not poison the embed
                 p = p[:int(np.argmax(bad))]
@@ -1377,139 +1636,160 @@ class ContinuousBatcher:
             raise ValueError(f"ticks must be >= 1, got {ticks}")
         before = set(self._finished)
         remaining = int(ticks)
-        while remaining > 0:
-            with trace.span("serve/admission",
-                            queued=len(self._queue), parked=len(self._parked)):
-                self._admit()
-                if self.prefill_ahead and self._queue:
-                    self._prefill_batch(
-                        self.prefill_ahead - len(self._parked))
-            active = [a for a in self._slots if a is not None]
-            self._update_occupancy_gauges()
-            if not active:
-                break
-            greedy = all(a.req.temperature <= 0.0 for a in active)
-            # speculative verify tick (inference/specdec.py): one drafted
-            # k-wide verify forward in place of this iteration's window;
-            # counts as ONE tick.  _spec_tick returns False when no slot
-            # produced a draft — fall through to a plain window (k=0
-            # degenerates gracefully, never a wasted verify dispatch).
-            if self.specdec is not None and self.specdec.active() and \
-                    self._spec_tick(greedy):
-                remaining -= 1
-                continue
-            sub = remaining
-            if self._queue or self._parked:
-                t2r = min(a.req.max_new_tokens - len(a.emitted)
-                          for a in active)
-                sub = max(1, min(remaining, t2r))
-                if sub & (sub - 1):
-                    # pow2 windows keep the executable cache bounded; round
-                    # UP, not down: overshoot ticks decode discarded pads
-                    # (~ms each) while every extra window costs a full
-                    # host round-trip (~130 ms on the tunneled chip —
-                    # rounding 63 down fragmented it into six windows).
-                    # Cap at the largest pow2 <= remaining so every window
-                    # stays a warmed-up pow2 executable.  A slot past its
-                    # max_new_tokens keeps decoding until the boundary;
-                    # its cache writes clamp at the cache edge, corrupting
-                    # only its own finished (discarded) row, which
-                    # placement fully overwrites.
-                    sub = min(1 << sub.bit_length(),
-                              1 << (remaining.bit_length() - 1))
-            slot_ids = np.arange(self.n_slots)
-            t_window = time.perf_counter()
-            # roofline attribution: sampled windows record host wall
-            # against the window executable's AOT-harvested costs
-            # (warmup_windows fed them via record_compiled; ensure_costs
-            # is the un-warmed fallback).  The wall below is already
-            # fenced by the token fetch — sampling adds no sync.
-            sg = f"{int(sub)}{'g' if greedy else 's'}"
-            attr_site = None
-            if attribution.enabled():
-                site = (f"serving.decode_paged[{sg}]"
-                        if self.paged is not None
-                        else f"serving.decode[{sg}]")
-                if attribution.should_sample(site):
-                    attr_site = site
-            with trace.span("serve/decode-tick", ticks=int(sub),
-                            active=len(active),
-                            uids=self._active_uids()):
-                if self.paged is not None:
-                    # one BATCHED forward over the arena-backed paged
-                    # cache tree; the arena rides in donated and comes
-                    # back rebound (adopt).  note_window mirrors the
-                    # on-device head advance into the host lengths.
-                    window_fn = self._paged_multi_step(int(sub), greedy)
-                    window_args = (
-                        self.engine.params, self.paged.decode_cache(),
-                        self._token, self._pos, slot_ids, self._temp,
-                        self._top_p, self._rep, self._seen,
-                        self._done, jnp.int32(self._tick_no),
-                        jnp.int32(self.eos), jnp.int32(self.pad))
-                    attr_sigs0 = getattr(window_fn, "signatures_seen",
-                                         None) if attr_site else None
-                    toks, cache, self._token, self._pos, self._seen, \
-                        done = window_fn(*window_args)
-                    self.paged.adopt(cache)
-                    self.paged.note_window(int(sub))
-                else:
-                    window_fn = self._multi_step(int(sub), greedy)
-                    window_args = (
-                        self.engine.params, self._cache, self._token,
-                        self._pos, slot_ids, self._temp, self._top_p,
-                        self._rep, self._seen, self._done,
-                        jnp.int32(self._tick_no), jnp.int32(self.eos),
-                        jnp.int32(self.pad))
-                    attr_sigs0 = getattr(window_fn, "signatures_seen",
-                                         None) if attr_site else None
-                    toks, self._cache, self._token, self._pos, \
-                        self._seen, done = window_fn(*window_args)
-                self._tick_no += int(sub)
-                self._done = done
-                # the fetch is part of the tick's host wall time
-                tok_h = np.asarray(jax.device_get(toks))[:, :, 0]
-            if attr_site is not None:
-                # compile-paying windows are discarded inside
-                # note_window; a recorded (steady) window also runs the
-                # one-shot lazy cost harvest AFTER the measured interval
-                # (lower only reads avals — the donated arena in
-                # window_args is safe)
-                attribution.note_window(attr_site,
-                                        time.perf_counter() - t_window,
-                                        window_fn, attr_sigs0, window_args)
-            self._m_ticks.inc(int(sub))
-            appended = 0
-            emitted_by_uid: Dict[int, int] = {}
-            for t in range(int(sub)):
-                for i, act in enumerate(self._slots):
-                    if act is None:
-                        continue
-                    tokv = int(tok_h[t, i])
-                    act.emitted.append(tokv)
-                    appended += 1
-                    if self._lifecycle_observers:
-                        emitted_by_uid[act.req.uid] = \
-                            emitted_by_uid.get(act.req.uid, 0) + 1
-                    if (self.eos >= 0 and tokv == self.eos) or \
-                            len(act.emitted) >= act.req.max_new_tokens:
-                        # flush this request's emit BEFORE retire —
-                        # observers may treat retire as terminal
-                        n_emit = emitted_by_uid.pop(act.req.uid, 0)
-                        if n_emit:
-                            self._note_lifecycle(act.req.uid, "emit",
-                                                 kind="decode", n=n_emit,
-                                                 tick=self._tick_no)
-                        self._retire(i)
-            if self._lifecycle_observers:
-                for uid, n_emit in emitted_by_uid.items():
-                    self._note_lifecycle(uid, "emit", kind="decode",
-                                         n=n_emit, tick=self._tick_no)
-            if appended:
-                self._note_tpot(time.perf_counter() - t_window, appended)
-            if self.specdec is not None:
-                self.specdec.note_plain(int(sub))
-            remaining -= int(sub)
+        # the SIGTERM drain hook must not re-enter a half-advanced
+        # step; the finally guarantees an exception escaping a
+        # window can never permanently disable graceful drain
+        self._in_step = True
+        try:
+            while remaining > 0:
+                if self.admission is not None:
+                    # ladder evaluation (throttled ~1/s) + the deadline
+                    # sweep: expired slots free BEFORE admission so their
+                    # capacity is reusable this very step
+                    self.admission.maybe_step()
+                    self._deadline_sweep()
+                with trace.span("serve/admission",
+                                queued=len(self._queue), parked=len(self._parked)):
+                    self._admit()
+                    if self.prefill_ahead and self._queue:
+                        self._prefill_batch(
+                            self.prefill_ahead - len(self._parked))
+                active = [a for a in self._slots if a is not None]
+                self._update_occupancy_gauges()
+                if not active:
+                    break
+                greedy = all(a.req.temperature <= 0.0 for a in active)
+                # speculative verify tick (inference/specdec.py): one drafted
+                # k-wide verify forward in place of this iteration's window;
+                # counts as ONE tick.  _spec_tick returns False when no slot
+                # produced a draft — fall through to a plain window (k=0
+                # degenerates gracefully, never a wasted verify dispatch).
+                if self.specdec is not None and self.specdec.active() and \
+                        (self.admission is None
+                         or self.admission.allow_specdec()) and \
+                        self._spec_tick(greedy):
+                    remaining -= 1
+                    continue
+                sub = remaining
+                if self._queue or self._parked:
+                    t2r = min(a.req.max_new_tokens - len(a.emitted)
+                              for a in active)
+                    sub = max(1, min(remaining, t2r))
+                    if sub & (sub - 1):
+                        # pow2 windows keep the executable cache bounded; round
+                        # UP, not down: overshoot ticks decode discarded pads
+                        # (~ms each) while every extra window costs a full
+                        # host round-trip (~130 ms on the tunneled chip —
+                        # rounding 63 down fragmented it into six windows).
+                        # Cap at the largest pow2 <= remaining so every window
+                        # stays a warmed-up pow2 executable.  A slot past its
+                        # max_new_tokens keeps decoding until the boundary;
+                        # its cache writes clamp at the cache edge, corrupting
+                        # only its own finished (discarded) row, which
+                        # placement fully overwrites.
+                        sub = min(1 << sub.bit_length(),
+                                  1 << (remaining.bit_length() - 1))
+                slot_ids = np.arange(self.n_slots)
+                fault = chaos_mod.maybe_fire("slow_tick")
+                if fault is not None:
+                    # a straggler device / preempted core: the window
+                    # stalls, every queued request's TTFT clock keeps
+                    # running — the input that drives real slo_burn
+                    time.sleep(fault.arg if fault.arg is not None else 0.05)
+                t_window = time.perf_counter()
+                # roofline attribution: sampled windows record host wall
+                # against the window executable's AOT-harvested costs
+                # (warmup_windows fed them via record_compiled; ensure_costs
+                # is the un-warmed fallback).  The wall below is already
+                # fenced by the token fetch — sampling adds no sync.
+                sg = f"{int(sub)}{'g' if greedy else 's'}"
+                attr_site = None
+                if attribution.enabled():
+                    site = (f"serving.decode_paged[{sg}]"
+                            if self.paged is not None
+                            else f"serving.decode[{sg}]")
+                    if attribution.should_sample(site):
+                        attr_site = site
+                with trace.span("serve/decode-tick", ticks=int(sub),
+                                active=len(active),
+                                uids=self._active_uids()):
+                    if self.paged is not None:
+                        # one BATCHED forward over the arena-backed paged
+                        # cache tree; the arena rides in donated and comes
+                        # back rebound (adopt).  note_window mirrors the
+                        # on-device head advance into the host lengths.
+                        window_fn = self._paged_multi_step(int(sub), greedy)
+                        window_args = (
+                            self.engine.params, self.paged.decode_cache(),
+                            self._token, self._pos, slot_ids, self._temp,
+                            self._top_p, self._rep, self._seen,
+                            self._done, jnp.int32(self._tick_no),
+                            jnp.int32(self.eos), jnp.int32(self.pad))
+                        attr_sigs0 = getattr(window_fn, "signatures_seen",
+                                             None) if attr_site else None
+                        toks, cache, self._token, self._pos, self._seen, \
+                            done = window_fn(*window_args)
+                        self.paged.adopt(cache)
+                        self.paged.note_window(int(sub))
+                    else:
+                        window_fn = self._multi_step(int(sub), greedy)
+                        window_args = (
+                            self.engine.params, self._cache, self._token,
+                            self._pos, slot_ids, self._temp, self._top_p,
+                            self._rep, self._seen, self._done,
+                            jnp.int32(self._tick_no), jnp.int32(self.eos),
+                            jnp.int32(self.pad))
+                        attr_sigs0 = getattr(window_fn, "signatures_seen",
+                                             None) if attr_site else None
+                        toks, self._cache, self._token, self._pos, \
+                            self._seen, done = window_fn(*window_args)
+                    self._tick_no += int(sub)
+                    self._done = done
+                    # the fetch is part of the tick's host wall time
+                    tok_h = np.asarray(jax.device_get(toks))[:, :, 0]
+                if attr_site is not None:
+                    # compile-paying windows are discarded inside
+                    # note_window; a recorded (steady) window also runs the
+                    # one-shot lazy cost harvest AFTER the measured interval
+                    # (lower only reads avals — the donated arena in
+                    # window_args is safe)
+                    attribution.note_window(attr_site,
+                                            time.perf_counter() - t_window,
+                                            window_fn, attr_sigs0, window_args)
+                self._m_ticks.inc(int(sub))
+                appended = 0
+                emitted_by_uid: Dict[int, int] = {}
+                for t in range(int(sub)):
+                    for i, act in enumerate(self._slots):
+                        if act is None:
+                            continue
+                        tokv = int(tok_h[t, i])
+                        act.emitted.append(tokv)
+                        appended += 1
+                        if self._lifecycle_observers:
+                            emitted_by_uid[act.req.uid] = \
+                                emitted_by_uid.get(act.req.uid, 0) + 1
+                        if (self.eos >= 0 and tokv == self.eos) or \
+                                len(act.emitted) >= act.req.max_new_tokens:
+                            # flush this request's emit BEFORE retire —
+                            # observers may treat retire as terminal
+                            n_emit = emitted_by_uid.pop(act.req.uid, 0)
+                            if n_emit:
+                                self._note_lifecycle(act.req.uid, "emit",
+                                                     kind="decode", n=n_emit,
+                                                     tick=self._tick_no)
+                            self._retire(i)
+                if self._lifecycle_observers:
+                    for uid, n_emit in emitted_by_uid.items():
+                        self._note_lifecycle(uid, "emit", kind="decode",
+                                             n=n_emit, tick=self._tick_no)
+                if appended:
+                    self._note_tpot(time.perf_counter() - t_window, appended)
+                if self.specdec is not None:
+                    self.specdec.note_plain(int(sub))
+                remaining -= int(sub)
+        finally:
+            self._in_step = False
         in_flight = self._active_uids()
         # /healthz last-step age; the in-flight uids ride the flight
         # recorder's counter-delta context so a postmortem names the
@@ -1519,13 +1799,167 @@ class ContinuousBatcher:
         new = {u: self._finished[u] for u in self._finished if u not in before}
         return new
 
-    def run(self, prompts, ticks: int = 1, **gen_kwargs) -> List[np.ndarray]:
-        """Convenience: submit every prompt, step until drained, return
-        outputs in submission order."""
-        uids = [self.submit(p, **gen_kwargs) for p in prompts]
-        while any(u not in self._finished for u in uids):
+    def leak_counts(self) -> Dict[str, int]:
+        """Resources still owned by in-flight requests: occupied slots,
+        parked entries, and (paged mode) arena pages owned by
+        parked/active requests.  All three must be zero after a
+        completed drain or a finished trace — the ONE leak-check seam
+        ``drain()`` and the chaos harness's post-trace assertion share,
+        so a bookkeeping change cannot silently split them."""
+        return {
+            "slots": sum(s is not None for s in self._slots),
+            "parked": len(self._parked),
+            "pages": 0 if self.paged is None
+            else int(self.paged._slot_pages_n),
+        }
+
+    def _live_uids(self) -> set:
+        """Every uid that can still make progress (queued, parked, or
+        on a slot)."""
+        live = {r.uid for r in self._queue}
+        live.update(e[0].uid for e in self._parked)
+        live.update(a.req.uid for a in self._slots if a is not None)
+        return live
+
+    def wait(self, uids=None, *, ticks: int = 4,
+             timeout_s: Optional[float] = None,
+             max_ticks: Optional[int] = None,
+             partial: bool = False) -> Dict[int, np.ndarray]:
+        """Step until every requested uid reaches a TERMINAL state
+        (finished or rejected); returns {uid: tokens} for the finished
+        ones.  ``uids=None`` waits for everything currently in flight.
+
+        Replaces the unbounded busy-spin callers used to write by hand
+        (``while uid not in finished: step()``), which deadlocks the
+        moment a uid was shed or can otherwise never finish.  Guards:
+
+        - a uid that is neither finished, nor rejected, nor live in the
+          batcher can NEVER complete → ``RuntimeError`` immediately
+          (with ``partial=True``: return what finished instead);
+        - ``timeout_s`` / ``max_ticks`` bound the wait →
+          ``TimeoutError`` naming the unfinished uids (or the partial
+          result with ``partial=True``);
+        - rejected uids are a terminal outcome, not an error: they are
+          simply absent from the returned dict (``rejected`` maps them
+          to the shed reason)."""
+        targets = list(self._live_uids()) if uids is None else list(uids)
+        t0 = time.perf_counter()
+        ticks_done = 0
+        while True:
+            outstanding = [u for u in targets if u not in self._finished
+                           and u not in self._rejected]
+            if not outstanding:
+                break
+            live = self._live_uids()
+            dead = [u for u in outstanding if u not in live]
+            if dead:
+                if partial:
+                    break
+                raise RuntimeError(
+                    f"uids {dead} are neither pending nor finished nor "
+                    f"rejected — they can never complete (unknown uid, "
+                    f"or state lost); pass partial=True to collect "
+                    f"what did finish")
+            if timeout_s is not None and \
+                    time.perf_counter() - t0 >= timeout_s:
+                if partial:
+                    break
+                raise TimeoutError(
+                    f"wait(timeout_s={timeout_s}) expired with "
+                    f"{len(outstanding)} unfinished uids "
+                    f"{outstanding[:8]}")
+            if max_ticks is not None and ticks_done >= max_ticks:
+                if partial:
+                    break
+                raise TimeoutError(
+                    f"wait(max_ticks={max_ticks}) exhausted with "
+                    f"{len(outstanding)} unfinished uids "
+                    f"{outstanding[:8]}")
             self.step(ticks=ticks)
-        return [self._finished[u] for u in uids]
+            ticks_done += int(ticks)
+        return {u: self._finished[u] for u in targets
+                if u in self._finished}
+
+    def run(self, prompts, ticks: int = 1,
+            timeout_s: Optional[float] = None,
+            **gen_kwargs) -> List[Optional[np.ndarray]]:
+        """Convenience: submit every prompt, step until drained, return
+        outputs in submission order (``None`` for a request the
+        admission controller shed — impossible with admission off, so
+        the historical all-arrays return type is unchanged there)."""
+        uids = [self.submit(p, **gen_kwargs) for p in prompts]
+        self.wait(uids, ticks=ticks, timeout_s=timeout_s)
+        return [self._finished.get(u) for u in uids]
+
+    def drain(self, *, ticks: int = 8, timeout_s: Optional[float] = None,
+              flush: bool = True) -> dict:
+        """Graceful shutdown: stop admitting, finish in-flight work,
+        release every resource, flush forensics — the replica-restart
+        building block (SIGTERM in a flight-recorder-armed process runs
+        this automatically before the flight dump).
+
+        - new ``submit`` calls shed (``rejected`` outcome, reason
+          ``draining``) from the moment drain starts;
+        - queued/parked/slotted requests run to completion (or their
+          deadline) within ``timeout_s``; past the timeout the
+          remainder is FORCED out — queued requests shed
+          (``drain_timeout``), parked/slotted requests finished with
+          their partial output — so the batcher always ends with zero
+          leaked pages and zero occupied slots (paged KV refs return
+          to the radix tree through the normal retire/donate
+          discipline);
+        - ``flush`` writes the flight dump (reason ``drain``) and the
+          per-rank metrics exit dump, so a rolling restart keeps the
+          replica's final state.
+
+        Returns a summary dict (wall_s, completed, forced, leaks)."""
+        t0 = time.perf_counter()
+        self._draining = True
+        done0 = len(self._finished)
+        while self.pending:
+            if timeout_s is not None and \
+                    time.perf_counter() - t0 >= timeout_s:
+                break
+            self.step(ticks=ticks)
+        forced = 0
+        # graceful completions only: the force block below ALSO lands
+        # requests in _finished, and reporting those as "completed"
+        # would tell an operator a timed-out drain finished cleanly
+        completed = len(self._finished) - done0
+        if self.pending:
+            for r in list(self._queue):
+                self._queue.remove(r)
+                self._reject_queued(r, "drain_timeout")
+                forced += 1
+            for entry in list(self._parked):
+                req = entry[0]
+                self._parked.remove(entry)
+                if self.paged is not None:
+                    meta = self._parked_meta.pop(req.uid, None)
+                    if meta is not None:
+                        self.paged.finish_unslotted(meta, req.prompt)
+                self._finish_unslotted(req, [entry[5]])
+                forced += 1
+            for i, act in enumerate(self._slots):
+                if act is not None:
+                    self._retire(i)
+                    forced += 1
+        self._update_occupancy_gauges()
+        summary = {
+            "wall_s": round(time.perf_counter() - t0, 4),
+            "completed": completed,
+            "forced": forced,
+            **{f"leaked_{k}": v for k, v in self.leak_counts().items()},
+        }
+        if flush:
+            try:
+                self.latency_stats()     # refresh the percentile gauges
+            except Exception:
+                pass
+            telemetry_flightrec.dump("drain")
+            telemetry_registry.flush_exit_dump()
+        logger.info(f"batcher drained: {summary}")
+        return summary
 
     def warmup_windows(self, ticks: int, greedy: bool = True,
                        admission: bool = True) -> None:

@@ -38,6 +38,7 @@ import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from ..testing import chaos as _chaos
 from ..utils.logging import logger
 from . import registry as _registry
 
@@ -153,6 +154,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0]
+        if _chaos.maybe_fire("exporter_blackhole") is not None:
+            # injected wedged-observer fault: the scrape fails, serving
+            # must not notice (a fleet aggregator sees the replica
+            # degrade — HTTPError is a response, not a scrape failure)
+            try:
+                self._send(503, b"chaos: exporter blackhole\n",
+                           "text/plain")
+            except Exception:
+                pass
+            return
         try:
             if path == "/metrics":
                 _registry.run_collectors()
@@ -372,7 +383,8 @@ def maybe_start(port: Optional[int] = None) -> Optional[TelemetryExporter]:
     except ValueError:
         rank = 0
     bound = port + rank if port > 0 else 0
-    try:
+    _chaos.maybe_install_env()   # exporter-only processes resolve the
+    try:                         # DSTPU_CHAOS_PLAN here
         _exporter = TelemetryExporter(port=bound).start()
     except OSError as e:
         logger.warning(f"telemetry exporter failed to bind port {bound}: "

@@ -41,7 +41,7 @@ from ..utils.logging import logger
 from . import registry as _registry
 
 __all__ = ["FlightRecorder", "get_recorder", "maybe_install", "mark",
-           "dump", "pretty", "FLIGHT_DIR_ENV"]
+           "dump", "pretty", "add_sigterm_hook", "FLIGHT_DIR_ENV"]
 
 # separate override for the rare case flight dumps should land away from
 # the metrics dir; defaults to DSTPU_METRICS_DIR
@@ -251,9 +251,34 @@ def dump(reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
     return _recorder.dump(reason, exc) if _recorder is not None else None
 
 
+_sigterm_hooks: list = []
+
+
+def add_sigterm_hook(fn):
+    """Run ``fn()`` on SIGTERM BEFORE the flight dump — the graceful-
+    drain seam: a replica being terminated by the launcher finishes its
+    in-flight requests (``ContinuousBatcher.drain``), then the dump
+    snapshots the drained state.  SIGTERM only: SIGABRT means the
+    process is wedged, and a drain could hang the abort.  Hooks are
+    best-effort (exceptions swallowed — forensics must never mask the
+    shutdown); returns a zero-arg remover."""
+    _sigterm_hooks.append(fn)
+
+    def remove():
+        if fn in _sigterm_hooks:
+            _sigterm_hooks.remove(fn)
+    return remove
+
+
 def _on_signal(signum, frame):
     name = signal.Signals(signum).name if signum in list(signal.Signals) \
         else str(signum)
+    if signum == signal.SIGTERM:
+        for fn in list(_sigterm_hooks):
+            try:
+                fn()
+            except Exception:
+                pass
     dump(reason=f"signal:{name}")
     # the satellite fix: metrics must survive the launcher's SIGTERM
     # (atexit never runs under default signal death)

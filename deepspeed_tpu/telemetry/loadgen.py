@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import heapq
 import json
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -56,9 +57,9 @@ from . import registry as telemetry_registry
 
 __all__ = [
     "TraceConfig", "TraceRequest", "Trace", "generate_trace",
-    "trace_config_from_dict", "SLOConfig", "compute_goodput", "pct",
-    "LifecycleCollector", "LoadReport", "replay", "calibrate_slo",
-    "check_baseline",
+    "trace_config_from_dict", "SLOConfig", "RetryConfig",
+    "compute_goodput", "pct", "LifecycleCollector", "LoadReport",
+    "replay", "calibrate_slo", "check_baseline",
 ]
 
 
@@ -279,6 +280,22 @@ class SLOConfig:
 pct = telemetry_registry.pct
 
 
+@dataclasses.dataclass(frozen=True)
+class RetryConfig:
+    """Closed-loop client behavior for shed requests: a request the
+    admission controller rejects is re-submitted after a jittered
+    exponential backoff (``backoff_ms * 2^attempt * (1 + jitter*u)``,
+    ``u`` seeded-uniform), up to ``max_retries`` attempts.  This is how
+    real clients behave behind a shedding front-end — without it the
+    harness can only measure open-loop shed rates, not the closed-loop
+    goodput an operator actually gets."""
+
+    max_retries: int = 2
+    backoff_ms: float = 50.0
+    jitter: float = 0.5
+    seed: int = 0
+
+
 def compute_goodput(records: Sequence[dict], slo: SLOConfig,
                     wall_s: float) -> dict:
     """Goodput under SLO over completed-request ``records``.
@@ -286,14 +303,24 @@ def compute_goodput(records: Sequence[dict], slo: SLOConfig,
     Each record needs ``n_out`` (output tokens), ``ttft_ms``, and
     ``tpot_ms`` (None when n_out < 2).  Offered-but-unfinished requests
     should be passed with ``n_out=0, ttft_ms=inf`` — an unfinished
-    request is an SLO violation, not a statistical no-show."""
+    request is an SLO violation, not a statistical no-show.  Requests
+    shed at admission carry ``rejected=True`` (plus ``n_out=0,
+    ttft_ms=inf``): they count against SLO attainment exactly like
+    unfinished ones — shedding is never free in the headline number —
+    and ``slo_attainment_admitted`` reports attainment over the
+    admitted subset, so the controller's win for the requests it DID
+    serve is visible next to the cost of the sheds."""
     n = len(records)
     met_tokens = 0
     all_tokens = 0
     met = 0
+    rejected = 0
     ttfts: List[float] = []
     tpots: List[float] = []
     for r in records:
+        if r.get("rejected"):
+            rejected += 1
+            continue
         n_out = int(r["n_out"])
         all_tokens += n_out
         ttft = float(r["ttft_ms"])
@@ -314,6 +341,12 @@ def compute_goodput(records: Sequence[dict], slo: SLOConfig,
         "n_requests": n,
         "slo": slo.to_jsonable(),
         "slo_attainment": round(met / n, 6) if n else None,
+        # attainment over the ADMITTED subset (sheds excluded from the
+        # denominator HERE ONLY — the headline slo_attainment above
+        # counts them as violations)
+        "slo_attainment_admitted":
+            round(met / (n - rejected), 6) if n - rejected else None,
+        "rejected": rejected,
         "slo_met": met,
         "goodput_tok_s": round(met_tokens / wall, 3),
         "goodput_rps": round(met / wall, 4),
@@ -410,6 +443,7 @@ class LoadReport:
     phases: dict
     completed: int
     offered: int
+    rejected: int = 0          # shed at admission (final — post-retry)
 
     def to_jsonable(self) -> dict:
         return dataclasses.asdict(self)
@@ -429,6 +463,10 @@ class LoadReport:
             f"{'SLO attainment':<24}"
             f"{100.0 * (g['slo_attainment'] or 0.0):>9.1f}%"
             f"   ({g['slo_met']}/{g['n_requests']})",
+            *([f"{'rejected (shed)':<24}{self.rejected:>10d} requests"
+               f"   (admitted attainment "
+               f"{100.0 * (g.get('slo_attainment_admitted') or 0.0):.1f}%)"]
+              if self.rejected else []),
             f"{'goodput token ratio':<24}"
             # dstpu-lint: disable-next-line=DSTPU006 -- report JSON key read-back, not a registry metric
             f"{(g['goodput_token_ratio'] or 0.0):>10.3f}",
@@ -471,6 +509,15 @@ class LoadReport:
                 f"{w.get('prefix_hit_tokens', 0):>5} "
                 f"{'ok' if w.get('slo_ok') else 'VIOL'}"
                 + (f"  {links.get(w['uid'], '-')}" if links else ""))
+        rej = [w for w in self.waterfalls if w.get("rejected")]
+        if rej:
+            shown = ", ".join(
+                f"idx{w['idx']}={w['rejected']}"
+                + (f"(x{w['attempts']})" if w.get("attempts") else "")
+                for w in rej[:limit])
+            lines.append(
+                f"rejected (shed): {len(rej)} requests — {shown}"
+                + ("…" if len(rej) > limit else ""))
         return "\n".join(lines)
 
 
@@ -487,6 +534,7 @@ def _loadgen_status() -> Optional[dict]:
         "wall_s": round(_last_report.wall_s, 3),
         "offered": _last_report.offered,
         "completed": _last_report.completed,
+        "rejected": _last_report.rejected,
         "slo": g["slo"],
         "slo_attainment": g["slo_attainment"],
         "goodput_tok_s": g["goodput_tok_s"],
@@ -498,6 +546,7 @@ def _loadgen_status() -> Optional[dict]:
 
 def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
            ticks: int = 4, time_scale: float = 1.0,
+           retry=None,
            on_progress: Optional[Callable[[str], None]] = None
            ) -> LoadReport:
     """Replay ``trace`` against ``batcher`` in open loop and report
@@ -517,9 +566,22 @@ def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
     against effectively-infinite bounds.  A real ``slo`` is installed
     via ``set_slo`` for the duration and the previous bounds restored
     after — a load run must not permanently reconfigure a deployment's
-    batcher."""
+    batcher.
+
+    ``retry`` (a :class:`RetryConfig` or kwargs dict) enables
+    closed-loop client behavior against an admission-controlled
+    batcher: a SHED request is re-submitted after a seeded jittered
+    backoff, up to ``max_retries`` times.  A request whose final
+    attempt is still shed lands as a ``rejected`` outcome — counted
+    against SLO attainment, never a no-show."""
     judge = slo if slo is not None else SLOConfig(ttft_ms=1e12,
                                                  tpot_ms=1e12)
+    retry_cfg = None
+    if retry is not None:
+        retry_cfg = retry if isinstance(retry, RetryConfig) \
+            else RetryConfig(**retry)
+    retry_rng = np.random.default_rng(retry_cfg.seed) \
+        if retry_cfg is not None else None
     reqs = sorted(trace.requests, key=lambda r: r.arrival_s)
     collector = LifecycleCollector()
     remove = batcher.add_lifecycle_observer(collector)
@@ -529,18 +591,57 @@ def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
     gp0 = goodput_mod.summary()
     timeline: List[dict] = []
     uid_by_idx: Dict[int, int] = {}
+    attempts: Dict[int, int] = {}
+    retries: List[tuple] = []      # (due wall time, trace idx) heap
     t0 = time.perf_counter()
+    rej_live = getattr(batcher, "rejected", {})   # mutated in place
+    watched: Dict[int, int] = {}   # admitted uid -> idx (async sheds)
+
+    def _schedule_retry(idx: int) -> None:
+        a = attempts[idx] - 1
+        delay = (retry_cfg.backoff_ms / 1e3) * (2 ** a) \
+            * (1.0 + retry_cfg.jitter * float(retry_rng.random()))
+        heapq.heappush(retries, (time.perf_counter() + delay, idx))
+
+    def _submit(r) -> None:
+        uid = batcher.submit(r.prompt, max_new_tokens=r.max_new_tokens)
+        uid_by_idx[r.idx] = uid
+        attempts[r.idx] = attempts.get(r.idx, 0) + 1
+        if retry_cfg is None:
+            return
+        if uid in rej_live:            # shed synchronously at submit
+            if attempts[r.idx] <= retry_cfg.max_retries:
+                _schedule_retry(r.idx)
+        else:
+            watched[uid] = r.idx       # may still shed asynchronously
+
+    def _sweep_async_sheds() -> None:
+        """A request admitted at submit can still be shed LATER (queue
+        eviction by a higher-priority arrival, the deadline sweep,
+        drain) — the closed-loop client must retry those too, not just
+        the synchronous submit-time rejections."""
+        for uid in [u for u in watched if u in rej_live]:
+            ridx = watched.pop(uid)
+            if attempts[ridx] <= retry_cfg.max_retries:
+                _schedule_retry(ridx)
+
+    req_by_idx = {r.idx: r for r in reqs}
     try:
         i = 0
         last_progress = 0
         n = len(reqs)
-        while i < n or batcher.pending:
+        while i < n or retries or batcher.pending or (
+                retry_cfg is not None
+                and any(u in rej_live for u in watched)):
             now_v = (time.perf_counter() - t0) * time_scale
             while i < n and reqs[i].arrival_s <= now_v:
-                uid = batcher.submit(reqs[i].prompt,
-                                     max_new_tokens=reqs[i].max_new_tokens)
-                uid_by_idx[reqs[i].idx] = uid
+                _submit(reqs[i])
                 i += 1
+            if retry_cfg is not None and watched:
+                _sweep_async_sheds()
+            while retries and retries[0][0] <= time.perf_counter():
+                _, ridx = heapq.heappop(retries)
+                _submit(req_by_idx[ridx])
             # raw deque/slot reads, NOT _telemetry_status(): that sorts
             # the full latency windows per call, and this loop is inside
             # the very wall-clock the report measures
@@ -550,10 +651,14 @@ def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
                 "active": sum(s is not None for s in batcher._slots)})
             if batcher.pending:
                 batcher.step(ticks=ticks)
-            elif i < n:
-                time.sleep(min(
-                    max(0.0, (reqs[i].arrival_s - now_v) / time_scale),
-                    0.05))
+            else:
+                waits = []
+                if i < n:
+                    waits.append((reqs[i].arrival_s - now_v) / time_scale)
+                if retries:
+                    waits.append(retries[0][0] - time.perf_counter())
+                if waits:
+                    time.sleep(min(max(0.0, min(waits)), 0.05))
             if on_progress is not None and i - last_progress >= 64:
                 last_progress = i
                 on_progress(f"submitted {i}/{n}, pending {batcher.pending}")
@@ -572,12 +677,26 @@ def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
     waterfalls: List[dict] = []
     records: List[dict] = []
     completed = 0
+    rejected = 0
+    rej_map = getattr(batcher, "rejected", {})
     for r in reqs:
         uid = uid_by_idx.get(r.idx)
         w = collector.waterfall(uid, t0) if uid is not None else {"uid": None}
         w["idx"] = r.idx
         w["arrival_s"] = round(r.arrival_s, 6)
         w["shared_prefix"] = r.shared_prefix
+        if attempts.get(r.idx, 1) > 1:
+            w["attempts"] = attempts[r.idx]
+        if uid is not None and uid in rej_map:
+            # shed at admission (post-retry, when retries were
+            # enabled): a first-class outcome — counts against SLO
+            # attainment like offered-but-unfinished, never a no-show
+            w["rejected"] = rej_map[uid]
+            rejected += 1
+            waterfalls.append(w)
+            records.append({"n_out": 0, "ttft_ms": float("inf"),
+                            "tpot_ms": None, "rejected": True})
+            continue
         # coordinated-omission guard: the submit call can lag the
         # TRACE arrival (the loop was inside batcher.step when the
         # arrival time passed), and the batcher stamps TTFT at submit —
@@ -618,7 +737,7 @@ def replay(batcher, trace: Trace, slo: Optional[SLOConfig], *,
         trace_config=dataclasses.asdict(trace.config),
         slo=judge.to_jsonable(), wall_s=round(wall, 4), goodput=g,
         waterfalls=waterfalls, queue_timeline=timeline, phases=phases,
-        completed=completed, offered=len(reqs))
+        completed=completed, offered=len(reqs), rejected=rejected)
 
     # registry + /statusz surfaces (scrapers see load runs without
     # reading the report file)
@@ -668,8 +787,9 @@ def calibrate_slo(batcher, *, prompt_len: int = 16, max_new: int = 8,
             prompt = rng.integers(0, batcher._vocab,
                                   size=(prompt_len,)).astype(np.int32)
             uid = batcher.submit(prompt, max_new_tokens=max_new)
-            while uid not in batcher._finished:
-                batcher.step(ticks=4)
+            # wait() (not a hand-rolled spin): a shed calibration
+            # request terminates the wait instead of deadlocking it
+            batcher.wait([uid], ticks=4)
             ret = collector.first(uid, "retire")
             if ret is None:
                 continue

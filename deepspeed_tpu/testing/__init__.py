@@ -1,0 +1,4 @@
+"""Test-support subsystems that ship with the package (not under
+``tests/``): deterministic fault injection (:mod:`.chaos`) is wired
+through the serving stack at named sites, so the abort/rollback paths
+are exercisable from CI and load harnesses without monkeypatching."""

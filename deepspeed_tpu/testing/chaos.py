@@ -1,0 +1,318 @@
+"""Seeded fault injection at named sites in the serving stack.
+
+PR 8 built real abort/rollback machinery — paged-admission rollback,
+backpressure at page exhaustion, drafter degradation — but every one of
+those paths was only ever exercised by tests monkeypatching private
+methods.  This module makes fault injection a first-class, deterministic
+harness: the serving stack calls :func:`maybe_fire` at **named sites**,
+and a :class:`ChaosPlan` (seeded) decides which invocation of which site
+actually faults.  With no plan installed every site is a single
+``is None`` check — the production path pays one attribute load.
+
+Named sites (each is a real failure mode the stack must survive):
+
+- ``prefill_failure`` — the admission prefill dispatch raises mid-batch
+  (a transient device fault).  Exercises the contiguous path's
+  pin/unpin ``finally`` and the paged path's ``abort_admit`` rollback;
+  the batcher re-queues the group and retries next step.
+- ``page_pool_exhaustion`` — ``try_admit`` reports an empty pool even
+  though pages exist.  Exercises the backpressure path (tail re-queued
+  IN ORDER, admission stops for the step).
+- ``slow_tick`` — the decode window stalls ``arg`` seconds before
+  dispatch (a straggler device / preempted core).  Drives real SLO
+  burn, which is how the admission ladder is tested end-to-end.
+- ``drafter_exception`` — the speculative drafter raises inside
+  ``propose``.  The slot degrades to an empty proposal (plain-tick
+  fallback) instead of killing the serve loop.
+- ``exporter_blackhole`` — the telemetry exporter answers a scrape with
+  503 (a wedged observer).  Serving must be unaffected; a fleet
+  aggregator sees the replica degrade, not the process die.
+
+Determinism: each site keeps its own invocation counter (counting from
+plan install), and a :class:`FaultSpec` fires on exact invocation
+indices (``at``), a period (``every``), or a seeded per-site coin
+(``p``) — same plan + same workload ⇒ the same faults at the same
+invocations.  Every fire is recorded (site, invocation index, wall
+time) so a report can assert *exactly the planned faults fired*.
+
+Install programmatically (:func:`install_plan`) or via
+``DSTPU_CHAOS_PLAN=/path/to/plan.json`` (resolved by
+``ContinuousBatcher``/exporter startup through
+:func:`maybe_install_env`).  ``scripts/loadgen.py --chaos PLAN`` replays
+a trace under a plan and reports goodput-under-faults next to the clean
+number.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+__all__ = [
+    "ChaosFault", "FaultSpec", "ChaosPlan", "ChaosEngine", "SITES",
+    "CHAOS_PLAN_ENV", "install_plan", "clear", "get_engine", "maybe_fire",
+    "maybe_install_env",
+]
+
+CHAOS_PLAN_ENV = "DSTPU_CHAOS_PLAN"
+
+# the named sites threaded through the stack; a plan naming anything
+# else is a typo, rejected at construction (a fault that can never fire
+# would silently pass the "all planned faults fired" assertion's
+# complement)
+SITES: Tuple[str, ...] = (
+    "prefill_failure",
+    "page_pool_exhaustion",
+    "slow_tick",
+    "drafter_exception",
+    "exporter_blackhole",
+)
+
+
+class ChaosFault(RuntimeError):
+    """The injected failure.  Raised by sites whose real-world analog is
+    an exception (prefill dispatch, drafter); other sites consume the
+    spec behaviorally (exhaustion returns None, slow_tick sleeps)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """When one site faults.  Exactly one trigger should be set:
+    ``at`` (exact 0-based invocation indices of the site), ``every``
+    (each Nth invocation), or ``p`` (seeded per-invocation coin).
+    ``count`` caps total fires (0 = unlimited); ``arg`` is the
+    site-specific payload (``slow_tick``: stall seconds)."""
+
+    site: str
+    at: Tuple[int, ...] = ()
+    every: Optional[int] = None
+    p: float = 0.0
+    count: int = 0
+    arg: Optional[float] = None
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown chaos site {self.site!r}; "
+                             f"one of {SITES}")
+        if not self.at and self.every is None and self.p <= 0.0:
+            raise ValueError(
+                f"fault at site {self.site!r} can never fire: set at=, "
+                f"every=, or p=")
+        if self.every is not None and self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+
+    def to_jsonable(self) -> dict:
+        out: dict = {"site": self.site}
+        if self.at:
+            out["at"] = list(self.at)
+        if self.every is not None:
+            out["every"] = self.every
+        if self.p > 0.0:
+            out["p"] = self.p
+        if self.count:
+            out["count"] = self.count
+        if self.arg is not None:
+            out["arg"] = self.arg
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """A seeded set of fault specs — the whole fault-injection identity
+    (same plan + same workload ⇒ same faults at the same invocations)."""
+
+    seed: int = 0
+    faults: Tuple[FaultSpec, ...] = ()
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChaosPlan":
+        faults = []
+        for f in d.get("faults", ()):
+            kw = dict(f)
+            if "at" in kw:
+                kw["at"] = tuple(int(x) for x in kw["at"])
+            faults.append(FaultSpec(**kw))
+        return ChaosPlan(seed=int(d.get("seed", 0)), faults=tuple(faults))
+
+    @staticmethod
+    def from_json(text: str) -> "ChaosPlan":
+        return ChaosPlan.from_dict(json.loads(text))
+
+    @staticmethod
+    def load(path: str) -> "ChaosPlan":
+        with open(path) as fh:
+            return ChaosPlan.from_dict(json.load(fh))
+
+    def to_jsonable(self) -> dict:
+        return {"seed": self.seed,
+                "faults": [f.to_jsonable() for f in self.faults]}
+
+    def planned_sites(self) -> List[str]:
+        return sorted({f.site for f in self.faults})
+
+
+class ChaosEngine:
+    """Evaluates a plan against per-site invocation counters.
+
+    Thread-safe (the exporter site fires from the HTTP thread).  Every
+    fire lands in the ``fired`` log and the
+    ``chaos_faults_fired_total{site}`` counter, so "exactly the planned
+    faults fired" is assertable from the log and scrapeable from
+    ``/metrics``."""
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._invocations: Dict[str, int] = {s: 0 for s in SITES}
+        self._fires_left: List[Optional[int]] = [
+            (f.count if f.count else None) for f in plan.faults]
+        # one rng lane per fault spec, seeded from (plan seed, spec
+        # index): p-triggered fires are deterministic per spec no matter
+        # how other sites interleave
+        self._rngs = [np.random.default_rng([int(plan.seed), i])
+                      for i in range(len(plan.faults))]
+        self.fired: List[dict] = []
+        from ..telemetry import registry as telemetry_registry
+
+        self._m_fired = telemetry_registry.counter(
+            "chaos_faults_fired_total",
+            "injected faults fired, by site", labelnames=("site",))
+
+    def fire(self, site: str) -> Optional[FaultSpec]:
+        """One site invocation: returns the spec to apply when a fault
+        fires here, else None.  At most one spec fires per invocation
+        (first matching, plan order)."""
+        with self._lock:
+            inv = self._invocations[site]
+            self._invocations[site] = inv + 1
+            hit: Optional[FaultSpec] = None
+            for idx, f in enumerate(self.plan.faults):
+                if f.site != site:
+                    continue
+                left = self._fires_left[idx]
+                if left is not None and left <= 0:
+                    continue
+                # ``every`` is "each Nth invocation" (1-based): the
+                # first fire lands at invocation every-1, NOT at 0 — a
+                # rare-fault plan (every: 100) must not be
+                # indistinguishable from at: [0]
+                match = (inv in f.at) or \
+                    (f.every is not None
+                     and inv % f.every == f.every - 1) or \
+                    (f.p > 0.0 and self._rngs[idx].random() < f.p)
+                if match:
+                    if left is not None:
+                        self._fires_left[idx] = left - 1
+                    hit = f
+                    break
+            if hit is None:
+                return None
+            self.fired.append({"site": site, "invocation": inv,
+                               "t": time.time()})
+        self._m_fired.labels(site=site).inc()
+        logger.warning(f"chaos: fired {site} at invocation {inv}")
+        return hit
+
+    def summary(self) -> dict:
+        with self._lock:
+            by_site: Dict[str, int] = {}
+            for e in self.fired:
+                by_site[e["site"]] = by_site.get(e["site"], 0) + 1
+            return {
+                "seed": self.plan.seed,
+                "planned_sites": self.plan.planned_sites(),
+                "invocations": {s: n for s, n in
+                                self._invocations.items() if n},
+                "fired": dict(by_site),
+                "fired_events": list(self.fired),
+            }
+
+    def all_planned_fired(self) -> bool:
+        """Every site named by the plan fired at least once."""
+        fired_sites = {e["site"] for e in self.fired}
+        return set(self.plan.planned_sites()) <= fired_sites
+
+
+_engine: Optional[ChaosEngine] = None
+_status_registered = False
+
+
+def get_engine() -> Optional[ChaosEngine]:
+    return _engine
+
+
+def install_plan(plan: ChaosPlan) -> ChaosEngine:
+    """Install (replacing any previous engine) and expose the
+    ``/statusz`` ``chaos`` section."""
+    global _engine, _status_registered
+    _engine = ChaosEngine(plan)
+    if not _status_registered:
+        from ..telemetry import exporter as telemetry_exporter
+
+        telemetry_exporter.register_status_provider(
+            "chaos", lambda: None if _engine is None
+            else _engine.summary())
+        _status_registered = True
+    logger.warning(
+        f"chaos plan installed: seed={plan.seed} "
+        f"sites={plan.planned_sites()} ({len(plan.faults)} fault specs)")
+    return _engine
+
+
+def clear() -> None:
+    global _engine
+    _engine = None
+
+
+def maybe_fire(site: str) -> Optional[FaultSpec]:
+    """THE site hook: one attribute load when no plan is installed."""
+    eng = _engine
+    if eng is None:
+        return None
+    return eng.fire(site)
+
+
+def maybe_install_env() -> Optional[ChaosEngine]:
+    """Install the plan named by ``DSTPU_CHAOS_PLAN`` (a JSON file
+    path), once.  Called from batcher construction and exporter startup;
+    unset env = no-op, the default path stays fault-free."""
+    if _engine is not None:
+        return _engine
+    path = os.environ.get(CHAOS_PLAN_ENV, "").strip()
+    if not path:
+        return None
+    try:
+        return install_plan(ChaosPlan.load(path))
+    except Exception as e:
+        logger.warning(f"chaos: could not load plan {path!r}: {e!r}")
+        return None
+
+
+def assert_plan_fired(engine: Optional[ChaosEngine] = None,
+                      expected: Optional[Sequence[Tuple[str, int]]] = None
+                      ) -> dict:
+    """CI helper: raise unless every planned site fired (and, with
+    ``expected`` = [(site, invocation), ...], unless exactly those
+    (site, invocation) pairs fired).  Returns the engine summary."""
+    eng = engine or _engine
+    if eng is None:
+        raise AssertionError("no chaos engine installed")
+    s = eng.summary()
+    missing = set(eng.plan.planned_sites()) - set(s["fired"])
+    if missing:
+        raise AssertionError(
+            f"planned chaos sites never fired: {sorted(missing)} "
+            f"(fired: {s['fired']})")
+    if expected is not None:
+        got = [(e["site"], e["invocation"]) for e in s["fired_events"]]
+        if sorted(got) != sorted((str(a), int(b)) for a, b in expected):
+            raise AssertionError(
+                f"fired faults {sorted(got)} != planned {sorted(expected)}")
+    return s

@@ -31,6 +31,18 @@ Modes:
   # its trace file (open in ui.perfetto.dev)
   JAX_PLATFORMS=cpu python scripts/loadgen.py --seed 0 --trace-out traces/
 
+  # chaos: after the clean passes, replay once more under a seeded
+  # fault plan (testing/chaos.py) and report goodput-under-faults next
+  # to the clean number; assert every planned fault fired, zero leaked
+  # pages/slots, and a throughput floor
+  JAX_PLATFORMS=cpu python scripts/loadgen.py --seed 0 \
+      --chaos chaos_plan.json --chaos-assert-fired --chaos-floor 0.3
+
+  # admission control + closed-loop clients: bounded queue, deadline
+  # shedding (sheds count AGAINST attainment), client retry w/ backoff
+  JAX_PLATFORMS=cpu python scripts/loadgen.py --seed 0 --admission \
+      --max-queue-depth 8 --retries 2
+
 The SLO bounds are machine-relative by default (``calibrate_slo``:
 k× the box's own unloaded TTFT/TPOT), so the gate is portable across
 runner speeds; pass --slo-ttft-ms/--slo-tpot-ms for absolute bounds.
@@ -93,6 +105,29 @@ def parse_args(argv=None):
     ap.add_argument("--emit-trace", action="store_true",
                     help="print the trace JSON and exit (determinism "
                          "check: identical bytes for identical seeds)")
+    ap.add_argument("--admission", action="store_true",
+                    help="enable the SLO-aware admission controller "
+                         "(inference/admission.py): bounded queue, "
+                         "deadline shedding, degradation ladder")
+    ap.add_argument("--max-queue-depth", type=int, default=16,
+                    help="admission queue bound (with --admission)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline (with --admission)")
+    ap.add_argument("--retries", type=int, default=0,
+                    help="client retry-with-jittered-backoff attempts "
+                         "for shed requests (closed-loop behavior)")
+    ap.add_argument("--chaos", default=None, metavar="PLAN.json",
+                    help="after the clean measured passes, replay the "
+                         "trace once more under this seeded fault plan "
+                         "(testing/chaos.py) and report goodput-under-"
+                         "faults next to the clean number")
+    ap.add_argument("--chaos-floor", type=float, default=None,
+                    help="fail (exit 1) when the chaos pass's total "
+                         "token throughput falls below this fraction "
+                         "of the clean pass's")
+    ap.add_argument("--chaos-assert-fired", action="store_true",
+                    help="fail (exit 1) unless every site named by the "
+                         "chaos plan actually fired")
     ap.add_argument("--gate", default=None, metavar="BASELINE.json",
                     help="regression-gate mode against this baseline")
     ap.add_argument("--record-baseline", default=None, metavar="PATH",
@@ -133,9 +168,16 @@ def build_batcher(args):
     eng = deepspeed_tpu.init_inference(model=model, dtype=jnp.float32,
                                        params=params,
                                        max_tokens=args.max_total)
+    admission = None
+    if getattr(args, "admission", False):
+        admission = {"max_queue_depth": getattr(args, "max_queue_depth",
+                                                16)}
+        if getattr(args, "deadline_ms", None) is not None:
+            admission["deadline_ms"] = args.deadline_ms
     return ContinuousBatcher(
         eng, n_slots=args.slots,
-        prefix_cache={} if getattr(args, "prefix_cache", False) else None
+        prefix_cache={} if getattr(args, "prefix_cache", False) else None,
+        admission=admission
     ), cfg
 
 
@@ -146,12 +188,17 @@ _CALIBRATION = {"prompt_len": 8, "max_new": 6, "runs": 3,
 def run_load(args, trace_cfg, calibration=None):
     """Warm thoroughly, calibrate (or take absolute bounds), replay
     ``--passes`` times; returns (best_report, all_reports, slo,
-    tracer).  ``calibration`` overrides ``_CALIBRATION`` (gate mode
-    passes the baseline's embedded dict so the gate always judges with
-    the SAME SLO scaling the floors were recorded against).  ``tracer``
-    is the request tracer attached for ``--trace-out`` (None
-    otherwise) — attached AFTER warmup/calibration, so retained traces
-    cover exactly the measured passes."""
+    tracer, chaos_result).  ``calibration`` overrides ``_CALIBRATION``
+    (gate mode passes the baseline's embedded dict so the gate always
+    judges with the SAME SLO scaling the floors were recorded
+    against).  ``tracer`` is the request tracer attached for
+    ``--trace-out`` (None otherwise) — attached AFTER warmup/
+    calibration, so retained traces cover exactly the measured passes.
+    ``chaos_result`` (with ``--chaos``; None otherwise) is
+    ``(report, fired_summary, leaks)`` from ONE extra replay of the
+    same trace under the seeded fault plan — installed after the clean
+    passes so warmup/calibration and the clean numbers are never
+    faulted."""
     from deepspeed_tpu.telemetry import loadgen
 
     batcher, _ = build_batcher(args)
@@ -189,15 +236,32 @@ def run_load(args, trace_cfg, calibration=None):
             sample=max(1, getattr(args, "trace_sample", 1)),
             ring=max(256, 2 * args.n_requests * max(1, args.passes)))
         tracer.attach(batcher)
+    retry = None
+    if getattr(args, "retries", 0):
+        retry = {"max_retries": int(args.retries), "seed": args.seed}
     reports = [loadgen.replay(batcher, trace, slo, ticks=args.ticks,
-                              time_scale=args.time_scale)
+                              time_scale=args.time_scale, retry=retry)
                for _ in range(max(1, args.passes))]
     if tracer is not None:
         tracer.detach()
     best = max(reports,
                key=lambda r: (r.goodput["slo_attainment"] or 0.0,
                               r.goodput["goodput_tok_s"]))
-    return best, reports, slo, tracer
+    chaos_result = None
+    if getattr(args, "chaos", None):
+        from deepspeed_tpu.testing import chaos as chaos_mod
+
+        plan = chaos_mod.ChaosPlan.load(args.chaos)
+        engine = chaos_mod.install_plan(plan)
+        try:
+            chaos_report = loadgen.replay(
+                batcher, trace, slo, ticks=args.ticks,
+                time_scale=args.time_scale, retry=retry)
+        finally:
+            fired = engine.summary()
+            chaos_mod.clear()
+        chaos_result = (chaos_report, fired, batcher.leak_counts())
+    return best, reports, slo, tracer, chaos_result
 
 
 def write_traces(out_dir, tracer):
@@ -224,6 +288,71 @@ def write_traces(out_dir, tracer):
     print(f"retained request traces: {len(links)} files under {out_dir} "
           f"(index: {index_path})")
     return links
+
+
+def chaos_verdict(args, clean_report, chaos_result) -> int:
+    """Print goodput-under-faults next to the clean pass and apply the
+    ``--chaos-floor`` / ``--chaos-assert-fired`` gates; returns the
+    exit code (0 = pass).  With ``--report`` the file holds BOTH
+    passes ({"clean", "chaos", "fired", "leaks", "verdict"}) — a CI
+    artifact named for the chaos run must actually contain the faulted
+    numbers and the fired-fault log, not just the clean pass."""
+    chaos_report, fired, leaks = chaos_result
+    gc_, gf = clean_report.goodput, chaos_report.goodput
+    print()
+    print("=== goodput under faults (seeded chaos plan) ===")
+    print(chaos_report.table())
+    ratio = (gf["total_tok_s"] / gc_["total_tok_s"]
+             if gc_["total_tok_s"] else None)
+    print(f"clean vs faulted throughput: {gc_['total_tok_s']:.1f} -> "
+          f"{gf['total_tok_s']:.1f} tok/s"
+          + (f" (x{ratio:.3f})" if ratio is not None else ""))
+    print(f"clean vs faulted attainment: "
+          f"{100.0 * (gc_['slo_attainment'] or 0.0):.1f}% -> "
+          f"{100.0 * (gf['slo_attainment'] or 0.0):.1f}%")
+    print(f"faults fired: {fired['fired']} "
+          f"(events: {[(e['site'], e['invocation']) for e in fired['fired_events']]})")
+    print(f"leaks after faulted trace: {leaks}")
+    rc = 0
+    if any(leaks.values()):
+        print(f"CHAOS FAIL: leaked resources after the faulted trace: "
+              f"{leaks}", file=sys.stderr)
+        rc = 1
+    if getattr(args, "chaos_assert_fired", False):
+        missing = set(fired["planned_sites"]) - set(fired["fired"])
+        if missing:
+            print(f"CHAOS FAIL: planned sites never fired: "
+                  f"{sorted(missing)}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"chaos: every planned site fired "
+                  f"({fired['planned_sites']})")
+    floor = getattr(args, "chaos_floor", None)
+    if floor is not None and ratio is not None:
+        if ratio < floor:
+            print(f"CHAOS FAIL: faulted throughput ratio {ratio:.3f} < "
+                  f"floor {floor}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"chaos: throughput ratio {ratio:.3f} >= floor {floor}")
+    print("chaos replay: " + ("PASS" if rc == 0 else "FAIL"))
+    if getattr(args, "report", None):
+        payload = {
+            "clean": clean_report.to_jsonable(),
+            "chaos": chaos_report.to_jsonable(),
+            "fired": fired, "leaks": leaks,
+            "throughput_ratio": ratio,
+            "verdict": "PASS" if rc == 0 else "FAIL",
+            "runner": {"model": args.model, "slots": args.slots,
+                       "ticks": args.ticks, "argv": sys.argv[1:]},
+        }
+        d = os.path.dirname(args.report)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.report, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"clean+chaos report written: {args.report}")
+    return rc
 
 
 def write_report(path, report, args):
@@ -271,7 +400,7 @@ def main(argv=None) -> int:
                   f"generator or config drifted; re-record deliberately",
                   file=sys.stderr)
             return 1
-        best, reports, slo, tracer = run_load(
+        best, reports, slo, tracer, chaos_result = run_load(
             args, trace_cfg, calibration=baseline.get("calibration"))
         print(best.table())
         if args.trace_out and tracer is not None:
@@ -288,18 +417,27 @@ def main(argv=None) -> int:
         attains = [r.goodput["slo_attainment"] for r in reports]
         print(f"gate: per-pass attainment {attains} (best pass judged)")
         print("serving-load gate: " + ("PASS" if ok else "FAIL"))
-        return 0 if ok else 1
+        rc = 0 if ok else 1
+        if chaos_result is not None:
+            # --gate + --chaos: the faulted replay gates too (it ran —
+            # ignoring its verdict would make the flags silently inert)
+            rc = max(rc, chaos_verdict(args, best, chaos_result))
+        return rc
 
     cfg = trace_config(args, loadgen, vocab_size=512)
-    best, reports, slo, tracer = run_load(args, cfg)
+    best, reports, slo, tracer, chaos_result = run_load(args, cfg)
     print(best.table())
     print()
     links = None
     if args.trace_out and tracer is not None:
         links = write_traces(args.trace_out, tracer)
     print(best.format_waterfalls(args.waterfalls, links=links))
-    if args.report:
-        write_report(args.report, best, args)
+    if args.report and chaos_result is None:
+        write_report(args.report, best, args)    # chaos_verdict writes
+    if chaos_result is not None:                 # the combined report
+        rc = chaos_verdict(args, best, chaos_result)
+        if rc:
+            return rc
     if args.record_baseline:
         g = best.goodput
         baseline = {
