@@ -1,5 +1,6 @@
 from .engine import InferenceEngine  # noqa: F401
 from .kvreuse import PagedKVPool, RadixPrefixCache  # noqa: F401
+from .router import PrefixSketch, ReplicaServer, Router  # noqa: F401
 from .serving import ContinuousBatcher  # noqa: F401
 from .specdec import (DraftModelDrafter, NGramDrafter,  # noqa: F401
                       SpecDecodeConfig, SpecDecoder, resolve_specdec)

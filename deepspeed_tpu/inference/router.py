@@ -1,0 +1,1390 @@
+"""Multi-replica serving router: the fleet's front door.
+
+Everything under ROADMAP item 2 so far built seams for this module:
+``fleet.FleetView`` exposes replica health + queue depth +
+``best_for_prefix`` (PR 10), ``submit(trace_context=)`` propagates W3C
+``traceparent`` across the process hop (PR 11), and PR 13 gave replicas
+first-class ``rejected`` outcomes and graceful ``drain()`` — the
+overload/failover semantics a front-end needs underneath.  This module
+is the front-end:
+
+- :class:`ReplicaServer` — a stdlib-http endpoint (the
+  ``telemetry/exporter.py`` thread pattern) wrapping ONE
+  :class:`~deepspeed_tpu.inference.serving.ContinuousBatcher`:
+  ``POST /submit`` (JSON token ids; 429 with a structured shed reason
+  when admission rejects, 503 while draining; accepts a ``traceparent``
+  header or body field and forwards it into the batcher's lifecycle),
+  ``GET /result`` / ``GET /results`` (terminal outcome incl. the
+  replica-side TTFT/TPOT and prefix-cache hit tokens),
+  ``POST /cancel``, ``GET /healthz`` (queue depth — the router's cheap
+  tie-break probe when no fleet aggregator runs).  A serve-loop thread
+  steps the batcher whenever work is pending, so the HTTP surface IS
+  the replica process.  Discovery rides the existing
+  ``telemetry_rank<k>.json`` → ``fleet.json`` machinery: ``start()``
+  publishes ``serve_rank<k>.json`` into ``DSTPU_METRICS_DIR`` and the
+  launcher merges a ``serve_port`` field into each ``fleet.json``
+  replica entry.
+
+- :class:`Router` — places each request on a replica using a
+  router-side **radix sketch** of recently-routed prompt prefixes
+  (:class:`PrefixSketch`: which replica last served each token-block
+  chain — a real per-prefix heat signal, upgrading
+  ``fleet.best_for_prefix``'s global-counter ranking), with queue-depth
+  tie-breaks (from the :class:`~deepspeed_tpu.telemetry.fleet.FleetView`
+  scrape when one is wired in, the router's own in-flight counts
+  otherwise).  ``down``/draining replicas are excluded; on a shed
+  (429), a drain (503) or a connection failure the router retries the
+  NEXT-best replica, with seeded jittered exponential backoff between
+  rounds (the ``loadgen.RetryConfig`` discipline).  A replica that
+  dies with admitted requests in flight is failed over: every
+  outstanding request is re-placed on the next-best replica, so an
+  admitted request is never lost.  Each hop is stamped into the
+  request's trace (the hop's span id rides the forwarded
+  ``traceparent``), so ``fleet.stitch_tracez`` over the router's
+  ``tracez()`` payload + the replicas' ``/tracez`` shows
+  router→replica spans under one trace id.
+
+- :func:`replay_routed` — the measurement harness: replays a seeded
+  ``telemetry/loadgen.py`` trace through a router and reports goodput
+  under SLO with per-request replica attribution and a per-replica
+  rollup (requests, hit tokens, sheds) — ``scripts/loadgen.py
+  --router N`` drives an in-process 2+-replica fleet through this to
+  compare prefix-affinity vs round-robin placement and to run the
+  kill-one-replica failover arm.
+
+Stdlib + numpy only at module scope: a router process needs no jax and
+no device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import registry as _registry
+from ..telemetry import reqtrace as _reqtrace
+from ..utils.logging import logger
+
+__all__ = [
+    "ReplicaServer", "PrefixSketch", "Router", "RoutedRequest",
+    "replay_routed", "write_serve_discovery", "SERVE_DISCOVERY_RE",
+]
+
+SERVE_DISCOVERY_RE = r"^serve_rank(\d+)\.json$"
+
+
+# ---------------------------------------------------------------------------
+# per-replica serve endpoint
+# ---------------------------------------------------------------------------
+
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    server_ref: "ReplicaServer" = None      # type: ignore[assignment]
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if code == 503:
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b"{}"
+        doc = json.loads(raw.decode() or "{}")
+        if not isinstance(doc, dict):
+            raise ValueError("request body must be a JSON object")
+        return doc
+
+    def do_GET(self):                        # noqa: N802 (http.server API)
+        srv = self.server_ref
+        path, _, query = self.path.partition("?")
+        try:
+            if path == "/healthz":
+                self._send(200, srv.health())
+            elif path == "/result":
+                uid = _query_int(query, "uid")
+                if uid is None:
+                    self._send(400, {"error": "missing ?uid="})
+                else:
+                    out = srv.result(uid)
+                    self._send(404 if out["status"] == "unknown" else 200,
+                               out)
+            elif path == "/results":
+                uids = _query_ints(query, "uids")
+                self._send(200, {"replica": srv.name,
+                                 "pending": srv.batcher.pending,
+                                 "results": {str(u): srv.result(u)
+                                             for u in uids}})
+            else:
+                self._send(404, {"error": "not found: try /submit /result "
+                                          "/results /cancel /healthz"})
+        except BrokenPipeError:
+            pass
+        except Exception as e:   # a bad request must never kill the loop
+            try:
+                self._send(500, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def do_POST(self):                       # noqa: N802
+        srv = self.server_ref
+        path, _, query = self.path.partition("?")
+        try:
+            if path == "/submit":
+                try:
+                    doc = self._body()
+                except Exception as e:
+                    self._send(400, {"error": f"bad JSON body: {e!r}"})
+                    return
+                tp = self.headers.get("traceparent") \
+                    or doc.get("traceparent")
+                code, payload = srv.submit(doc, trace_context=tp)
+                self._send(code, payload)
+            elif path == "/cancel":
+                uid = _query_int(query, "uid")
+                if uid is None:
+                    self._send(400, {"error": "missing ?uid="})
+                else:
+                    self._send(200, {"uid": uid,
+                                     "status": srv.cancel(uid)})
+            else:
+                self._send(404, {"error": "not found"})
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            try:
+                self._send(500, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def log_message(self, fmt, *args):       # access logs off stdout
+        logger.debug("replica server: " + fmt % args)
+
+
+def _query_int(query: str, key: str) -> Optional[int]:
+    from urllib.parse import parse_qs
+
+    v = parse_qs(query).get(key)
+    try:
+        return int(v[0]) if v else None
+    except ValueError:
+        return None
+
+
+def _query_ints(query: str, key: str) -> List[int]:
+    from urllib.parse import parse_qs
+
+    v = parse_qs(query).get(key)
+    if not v:
+        return []
+    out = []
+    for part in v[0].split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                pass
+    return out
+
+
+class ReplicaServer:
+    """One replica's network surface: a stdlib HTTP endpoint over a
+    :class:`ContinuousBatcher` plus the serve loop that steps it.
+
+    Routes (all JSON):
+
+    - ``POST /submit`` — body ``{"prompt": [ids], "max_new_tokens": N,
+      "temperature", "top_p", "repetition_penalty", "priority",
+      "deadline_ms"}``; a ``traceparent`` header (or body field) joins
+      the request to an existing distributed trace (the router hop).
+      200 ``{"uid", "replica", "queued"}`` on admission; **429** with
+      ``{"shed": reason}`` when the admission controller rejects
+      (queue_full / deadline / priority eviction — the caller should
+      try another replica); **503** while draining (the replica is
+      restarting — a router must fail over, not retry here).
+    - ``GET /result?uid=N`` — ``{"status": "pending"}`` |
+      ``{"status": "done", "tokens", "n_out", "ttft_ms", "tpot_ms",
+      "hit_tokens", "prefill_tokens"}`` | ``{"status": "shed",
+      "reason"}``; 404 on unknown uids.
+    - ``GET /results?uids=1,2,3`` — batched form (one poll per replica
+      per router sweep, not one per request).
+    - ``POST /cancel?uid=N`` — queued requests shed (reason
+      ``cancelled``); parked/slotted requests finish immediately with
+      their partial output (the retire/donate discipline, zero leaks).
+    - ``GET /healthz`` — ``{"ok", "draining", "queue_depth",
+      "active_slots", "pending"}``: the router's tie-break probe.
+
+    Threading: HTTP handlers run on the server's thread pool; batcher
+    MUTATIONS (submit/cancel/step/drain) serialize on one lock, while
+    result/health reads are lock-free (bounded dict/deque reads —
+    a poll must not wait out a decode window).  ``start()`` launches
+    the serve loop, which steps the batcher whenever work is pending
+    and parks on an event otherwise.
+    """
+
+    def __init__(self, batcher, *, port: int = 0, host: str = "127.0.0.1",
+                 ticks: int = 4, name: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 metrics_dir: Optional[str] = None):
+        self.batcher = batcher
+        self.host = host
+        self.ticks = int(ticks)
+        self._requested_port = int(port)
+        if rank is None:
+            try:
+                rank = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
+            except ValueError:
+                rank = 0
+        self.rank = rank
+        self.name = name or f"rank{rank}"
+        self.metrics_dir = metrics_dir
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._draining = False
+        self._killed = False
+        # per-uid terminal metadata captured off the lifecycle stream
+        # (the /result payload's ttft/hit-token fields); bounded like
+        # the batcher's own _rejected window
+        self._meta: Dict[int, dict] = {}
+        self._meta_order: deque = deque()
+        # uids admitted over HTTP and not yet observed terminal: the
+        # AUTHORITATIVE "pending" set for /result.  The batcher's own
+        # queue/parked/slot scan has a limbo window (a request popped
+        # from the queue mid-prefill — which can span a multi-second
+        # compile — is in none of them), and reporting "unknown" there
+        # makes the router fail over a perfectly live request.
+        self._open: set = set()
+        self._remove_observer = batcher.add_lifecycle_observer(
+            self._on_lifecycle)
+        self._m_http = _registry.counter(
+            "replica_server_http_requests_total",
+            "requests handled by the per-replica serve endpoint",
+            labelnames=("route",))
+        self._n_submitted = 0
+        self._n_shed = 0
+        from ..telemetry import exporter as _exporter
+
+        _exporter.register_status_owner("replica_server", self, "_status")
+
+    # -- lifecycle capture ---------------------------------------------
+    def _on_lifecycle(self, t: float, uid: int, event: str,
+                      extra: dict) -> None:
+        if event == "prefill_start":
+            meta = self._meta.setdefault(uid, {})
+            meta["hit_tokens"] = int(extra.get("hit_tokens") or 0)
+            meta["prefill_tokens"] = int(extra.get("prefill_tokens") or 0)
+        elif event == "retire":
+            meta = self._meta.setdefault(uid, {})
+            for k in ("n_out", "ttft_ms", "tpot_ms", "slo_ok"):
+                if k in extra:
+                    meta[k] = extra[k]
+        else:
+            return
+        self._meta_order.append(uid)
+        while len(self._meta) > 8192 and self._meta_order:
+            old = self._meta_order.popleft()
+            if old != uid:
+                self._meta.pop(old, None)
+
+    # -- route implementations (handler-thread side) --------------------
+    def submit(self, doc: dict, trace_context=None) -> Tuple[int, dict]:
+        self._m_http.labels(route="submit").inc()
+        prompt = doc.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return 400, {"error": "prompt must be a non-empty list of "
+                                  "token ids"}
+        kwargs = {}
+        for key, cast in (("max_new_tokens", int), ("temperature", float),
+                          ("top_p", float), ("repetition_penalty", float),
+                          ("priority", int), ("deadline_ms", float)):
+            if doc.get(key) is not None:
+                kwargs[key] = cast(doc[key])
+        try:
+            with self._lock:
+                uid = self.batcher.submit(
+                    np.asarray(prompt, np.int32),
+                    trace_context=trace_context, **kwargs)
+        except ValueError as e:      # oversized prompt / bad ids
+            return 400, {"error": str(e)}
+        self._wake.set()
+        reason = self.batcher.rejected.get(uid)
+        if reason is not None:
+            self._n_shed += 1
+            code = 503 if reason in ("draining", "drain_timeout") else 429
+            return code, {"shed": reason, "uid": uid, "replica": self.name}
+        self._n_submitted += 1
+        self._open.add(uid)
+        return 200, {"uid": uid, "replica": self.name,
+                     "queued": self.batcher.pending}
+
+    def result(self, uid: int) -> dict:
+        b = self.batcher
+        tokens = b._finished.get(uid)
+        if tokens is not None:
+            self._open.discard(uid)
+            meta = self._meta.get(uid, {})
+            return {"status": "done",
+                    "tokens": [int(t) for t in tokens],
+                    "n_out": meta.get("n_out"),
+                    "ttft_ms": meta.get("ttft_ms"),
+                    "tpot_ms": meta.get("tpot_ms"),
+                    "slo_ok": meta.get("slo_ok"),
+                    "hit_tokens": meta.get("hit_tokens", 0),
+                    "prefill_tokens": meta.get("prefill_tokens", 0)}
+        reason = b.rejected.get(uid)
+        if reason is not None:
+            self._open.discard(uid)
+            return {"status": "shed", "reason": reason}
+        if uid in self._open or uid in b._live_uids():
+            return {"status": "pending"}
+        return {"status": "unknown"}
+
+    def cancel(self, uid: int) -> str:
+        self._m_http.labels(route="cancel").inc()
+        with self._lock:
+            return self.batcher.cancel(uid)
+
+    def health(self) -> dict:
+        b = self.batcher
+        return {
+            "ok": not self._draining,
+            "replica": self.name,
+            "draining": self._draining,
+            "queue_depth": len(b._queue) + len(b._parked),
+            "active_slots": sum(s is not None for s in b._slots),
+            "pending": b.pending,
+        }
+
+    def _status(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "draining": self._draining,
+            "submitted": self._n_submitted,
+            "shed": self._n_shed,
+            **self.health(),
+        }
+
+    # -- the serve loop -------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            failed = False
+            with self._lock:
+                pending = 0 if self._stop.is_set() else \
+                    self.batcher.pending
+                if pending:
+                    try:
+                        self.batcher.step(ticks=self.ticks)
+                    except Exception as e:   # the loop must survive a
+                        logger.warning(      # poisoned step
+                            f"replica server {self.name}: step failed: "
+                            f"{e!r}")
+                        failed = True
+            if failed:
+                time.sleep(0.05)       # OUTSIDE the lock: a poisoned
+            elif not pending:          # step must not also block submits
+                self._wake.wait(0.02)
+                self._wake.clear()
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self.host}:{self.port}" if self._server else None
+
+    @property
+    def target(self) -> Optional[str]:
+        return f"{self.host}:{self.port}" if self._server else None
+
+    def start(self) -> "ReplicaServer":
+        if self._server is not None:
+            return self
+        handler = type("_BoundReplicaHandler", (_ReplicaHandler,),
+                       {"server_ref": self})
+        self._server = ThreadingHTTPServer(
+            (self.host, self._requested_port), handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever,
+                         name=f"dstpu-replica-{self.name}",
+                         daemon=True).start()
+        self._loop_thread = threading.Thread(
+            target=self._loop, name=f"dstpu-serve-{self.name}",
+            daemon=True)
+        self._loop_thread.start()
+        write_serve_discovery(self, self.rank, self.metrics_dir)
+        logger.info(f"replica server {self.name} serving /submit /result "
+                    f"/cancel /healthz on {self.url}")
+        return self
+
+    def drain(self, timeout_s: Optional[float] = None,
+              flush: bool = False) -> dict:
+        """Graceful shutdown of the REPLICA (the endpoint stays up and
+        answers 503 on submits + serves remaining results): stops
+        admitting, finishes in-flight work via the batcher's own
+        ``drain()``."""
+        self._draining = True
+        with self._lock:
+            return self.batcher.drain(ticks=self.ticks,
+                                      timeout_s=timeout_s, flush=flush)
+
+    def stop(self) -> None:
+        """Clean stop: drain first if you care about in-flight work."""
+        self._stop.set()
+        self._wake.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+            self._loop_thread = None
+        if self._remove_observer is not None:
+            try:
+                self._remove_observer()
+            except Exception:
+                pass
+            self._remove_observer = None
+
+    def kill(self) -> None:
+        """Abrupt death (the failover test arm): the endpoint vanishes
+        mid-flight with NO drain — in-flight work is abandoned exactly
+        like a SIGKILLed process, and the router must fail its admitted
+        requests over to the survivors."""
+        self._killed = True
+        self.stop()
+
+
+def write_serve_discovery(server: "ReplicaServer", rank: int,
+                          directory: Optional[str] = None
+                          ) -> Optional[str]:
+    """Publish the replica's BOUND serve address as
+    ``<dir>/serve_rank<k>.json`` — the serve-endpoint sibling of
+    ``exporter.write_discovery``: the launcher merges it into each
+    ``fleet.json`` replica entry as ``serve_port``, which is how a
+    router discovers where to POST.  Best-effort; atomic rename."""
+    directory = directory or os.environ.get(_registry.METRICS_DIR_ENV)
+    if not directory or server is None or server.port is None:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"serve_rank{rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"rank": rank, "host": server.host,
+                       "port": server.port, "pid": os.getpid(),
+                       "unix_time": time.time()}, fh)
+        os.replace(tmp, path)
+        return path
+    except Exception as e:
+        logger.warning(f"router: could not write serve discovery: {e!r}")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the router-side prefix heat sketch
+# ---------------------------------------------------------------------------
+
+class PrefixSketch:
+    """Radix sketch of recently-routed prompt prefixes: which replica a
+    token-block chain was last placed on.
+
+    The replica-side radix cache (``kvreuse``) knows exactly which
+    pages it holds, but shipping tree contents to a router would couple
+    the control plane to cache internals.  The router instead keeps its
+    OWN block-chain → (replica, t) map, updated on every successful
+    placement: if the sketch says replica R last served blocks
+    ``[b0,b1,b2]`` of this prompt, R's radix cache holds (or very
+    recently held) those pages — a per-prefix heat signal, unlike the
+    global ``prefix_cache_hit_tokens_total`` counter ranking
+    ``fleet.best_for_prefix`` uses.
+
+    - keys are byte-exact block-aligned prefixes (``block_tokens``
+      should match the replica caches' ``page_tokens`` — sketch blocks
+      that straddle page boundaries would claim heat the cache can't
+      deliver);
+    - entries older than ``decay_s`` are ignored and lazily pruned
+      (a replica's cache churns; stale heat must not pin traffic);
+    - bounded LRU (``max_entries``) — it is a sketch, not a mirror.
+    """
+
+    def __init__(self, block_tokens: int = 16, max_entries: int = 4096,
+                 decay_s: float = 300.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got "
+                             f"{block_tokens}")
+        self.block_tokens = int(block_tokens)
+        self.max_entries = int(max_entries)
+        self.decay_s = float(decay_s)
+        self._clock = clock
+        self._entries: "OrderedDict[bytes, Tuple[str, float]]" = \
+            OrderedDict()
+
+    def _keys(self, prompt: np.ndarray) -> List[bytes]:
+        bt = self.block_tokens
+        arr = np.asarray(prompt, np.int32)
+        return [arr[:k * bt].tobytes()
+                for k in range(1, len(arr) // bt + 1)]
+
+    def note(self, prompt, replica: str) -> None:
+        """Record that ``replica`` now holds this prompt's block chain
+        (called after a successful placement)."""
+        now = self._clock()
+        for key in self._keys(prompt):
+            self._entries.pop(key, None)       # re-insert at MRU end
+            self._entries[key] = (replica, now)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+
+    def match_tokens(self, prompt) -> Dict[str, int]:
+        """Per-replica depth of the freshest block-chain match for this
+        prompt, in TOKENS: walk the chain shallow→deep, credit each
+        fresh entry's replica with that depth (deepest wins per
+        replica), stop at the first missing link (radix semantics: a
+        broken chain can't be cache-resident beyond the break)."""
+        now = self._clock()
+        out: Dict[str, int] = {}
+        bt = self.block_tokens
+        for depth, key in enumerate(self._keys(prompt), start=1):
+            entry = self._entries.get(key)
+            if entry is None:
+                break
+            replica, t = entry
+            if now - t > self.decay_s:
+                del self._entries[key]         # lazy prune
+                break
+            out[replica] = depth * bt
+        return out
+
+    def drop_replica(self, replica: str) -> int:
+        """Forget a replica's heat (it died/restarted: its cache is
+        gone).  Returns the number of entries dropped."""
+        dead = [k for k, (r, _) in self._entries.items() if r == replica]
+        for k in dead:
+            del self._entries[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+_SHED_REASON_RE = re.compile(r"^[a-z][a-z0-9_]{0,39}$")
+
+
+def _shed_label(code: int, payload: dict) -> str:
+    """Bounded label vocabulary for ``router_sheds_total``: admission
+    reasons are slugs and pass through, but a 400's ValueError text or a
+    500's repr would mint one labelset PER MESSAGE in the process-
+    lifetime registry — normalize anything non-slug to its code class."""
+    reason = payload.get("shed")
+    if isinstance(reason, str) and _SHED_REASON_RE.match(reason):
+        return reason
+    if code == 400:
+        return "bad_request"
+    if code >= 500:
+        return "server_error"
+    return f"http_{code}"
+
+
+@dataclasses.dataclass
+class RoutedRequest:
+    """One request's router-side state (the caller's handle is ``rid``)."""
+    rid: int
+    prompt: np.ndarray
+    gen: dict                        # forwarded generation kwargs
+    ctx: "_reqtrace.TraceContext"
+    t_submit: float                  # perf_counter
+    state: str = "placing"           # placing | admitted | done | shed
+    replica: Optional[str] = None
+    uid: Optional[int] = None
+    attempts: int = 0                # placement POSTs issued
+    failovers: int = 0               # re-placements after replica death
+    replacements: int = 0            # ALL re-placements (incl. async shed)
+    unknown_polls: int = 0           # consecutive "unknown" results
+    t_admitted: Optional[float] = None   # perf_counter at the LAST
+    #                                      admitting hop (TTFT anchor)
+    hops: List[dict] = dataclasses.field(default_factory=list)
+    spans: List[dict] = dataclasses.field(default_factory=list)
+    result: Optional[dict] = None    # the /result "done" payload
+    shed_reason: Optional[str] = None
+    t_done: Optional[float] = None
+
+
+class _RouterRep:
+    """Router-internal per-replica bookkeeping."""
+
+    def __init__(self, name: str, serve: str):
+        self.name = name
+        self.serve = serve                    # host:port
+        self.placed = 0
+        self.sheds = 0
+        self.conn_fails = 0                   # consecutive (poll side)
+        self.suspect_until = 0.0              # monotonic
+        self.draining_until = 0.0
+        self.in_flight: set = set()
+
+
+class Router:
+    """Prefix-affinity, failure-aware placement over N replica serve
+    endpoints.
+
+    Placement (``policy="affinity"``, the default): rank routable
+    replicas by the :class:`PrefixSketch` match depth for this prompt
+    (descending), tie-break toward the shallower queue (the
+    ``fleet_view``'s scraped ``queue_depth`` when wired, the router's
+    own in-flight count otherwise), then by name for determinism.
+    ``policy="round_robin"`` rotates over routable replicas — the
+    control arm ``scripts/loadgen.py --router`` compares against.
+
+    Routable = known replicas minus: ``down`` per the fleet view,
+    recently connection-failed (``suspect_cooldown_s``), and recently
+    draining (a 503 marks the replica draining for
+    ``drain_cooldown_s``).
+
+    Failure handling: a 429 shed or a connection failure on submit
+    moves to the next rung of the ladder immediately; when a full round
+    of the ladder sheds, the router backs off with seeded jittered
+    exponential delay and retries, up to ``max_retries`` extra rounds
+    (the ``loadgen.RetryConfig`` discipline).  On the poll side, a
+    replica that fails ``failover_after`` consecutive polls (or
+    answers ``unknown`` for an admitted uid — a restarted process) is
+    marked suspect, its sketch heat dropped, and EVERY admitted
+    request on it is re-placed on the next-best replica: zero admitted
+    requests lost.
+
+    Tracing: every request gets a root trace context; each hop's
+    ``traceparent`` carries a fresh child span id, so the receiving
+    replica's spans chain under that hop.  ``tracez()`` returns the
+    router's own retained span trees in the ``/tracez?full=1`` payload
+    shape — feed it to ``fleet.stitch_tracez`` beside the replicas'
+    payloads for the end-to-end router→replica view.
+    """
+
+    def __init__(self, replicas=None, *, discovery_file: Optional[str] = None,
+                 fleet_view=None, policy: str = "affinity",
+                 block_tokens: int = 16, decay_s: float = 300.0,
+                 max_retries: int = 2, backoff_ms: float = 25.0,
+                 jitter: float = 0.5, failover_after: int = 2,
+                 suspect_cooldown_s: float = 30.0,
+                 drain_cooldown_s: float = 1.0,
+                 timeout_s: float = 5.0, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown policy {policy!r}; one of "
+                             f"('affinity', 'round_robin')")
+        self.policy = policy
+        self.fleet_view = fleet_view
+        self.discovery_file = discovery_file
+        self._discovery_mtime: Optional[float] = None
+        self.max_retries = int(max_retries)
+        self.backoff_ms = float(backoff_ms)
+        self.jitter = float(jitter)
+        self.failover_after = int(failover_after)
+        self.suspect_cooldown_s = float(suspect_cooldown_s)
+        self.drain_cooldown_s = float(drain_cooldown_s)
+        self.timeout_s = float(timeout_s)
+        self.seed = seed
+        self._clock = clock
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.RLock()
+        self.sketch = PrefixSketch(block_tokens=block_tokens,
+                                   decay_s=decay_s, clock=clock)
+        self._reps: "OrderedDict[str, _RouterRep]" = OrderedDict()
+        self._rr_counter = 0
+        self._next_rid = 0
+        self._requests: Dict[int, RoutedRequest] = {}
+        self._retained: deque = deque(maxlen=512)   # finished trace trees
+        if replicas:
+            if isinstance(replicas, dict):
+                for name, target in replicas.items():
+                    self._reps[str(name)] = _RouterRep(str(name),
+                                                       str(target))
+            else:
+                for target in replicas:
+                    self._reps[str(target)] = _RouterRep(str(target),
+                                                         str(target))
+        self._refresh_discovery(force=True)
+        self._m_requests = _registry.counter(
+            "router_requests_total", "requests the router placed, by "
+            "replica that admitted them", labelnames=("replica",))
+        self._m_sheds = _registry.counter(
+            "router_sheds_total",
+            "shed/unavailable responses seen while placing",
+            labelnames=("reason",))
+        self._m_retries = _registry.counter(
+            "router_retries_total",
+            "placement retry rounds after a full ladder shed")
+        self._m_failovers = _registry.counter(
+            "router_failovers_total",
+            "admitted requests re-placed after their replica failed")
+        self._m_match_tokens = _registry.counter(
+            "router_prefix_match_tokens_total",
+            "prompt tokens placed onto their sketch-matched replica "
+            "(the router-side affinity signal; compare with the "
+            "replicas' prefix_cache_hit_tokens_total ground truth)")
+        self._m_routable = _registry.gauge(
+            "router_replicas_routable",
+            "replicas the router currently considers routable")
+        from ..telemetry import exporter as _exporter
+
+        _exporter.register_status_owner("router", self, "_status")
+
+    # -- discovery ------------------------------------------------------
+    def _refresh_discovery(self, force: bool = False) -> None:
+        if not self.discovery_file:
+            return
+        try:
+            mtime = os.path.getmtime(self.discovery_file)
+        except OSError:
+            return
+        if not force and mtime == self._discovery_mtime:
+            return
+        from ..telemetry import fleet as _fleet
+
+        try:
+            entries = _fleet.read_discovery(self.discovery_file)
+        except Exception as e:
+            logger.warning(f"router: unreadable discovery file "
+                           f"{self.discovery_file}: {e!r}")
+            return
+        self._discovery_mtime = mtime
+        with self._lock:
+            seen = set()
+            for i, ent in enumerate(entries):
+                if "serve_port" not in ent:
+                    continue             # exporter-only rank: not a replica
+                name = f"rank{ent.get('rank', i)}"
+                target = f"{ent['host']}:{ent['serve_port']}"
+                seen.add(name)
+                rep = self._reps.get(name)
+                if rep is None:
+                    self._reps[name] = _RouterRep(name, target)
+                elif rep.serve != target:
+                    # restarted on a new port: fresh bookkeeping, and
+                    # its cache heat died with the old process
+                    logger.info(f"router: replica {name} moved "
+                                f"{rep.serve} -> {target}")
+                    self.sketch.drop_replica(name)
+                    self._reps[name] = _RouterRep(name, target)
+            for name in [n for n in self._reps if n not in seen]:
+                self.sketch.drop_replica(name)
+                del self._reps[name]
+
+    # -- transport (the test seam) --------------------------------------
+    def _post(self, target: str, path: str, doc: dict,
+              headers: Optional[dict] = None) -> Tuple[int, dict]:
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            f"http://{target}{path}", data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read().decode() or "{}")
+            except Exception:
+                return e.code, {}
+
+    def _get(self, target: str, path: str) -> Tuple[int, dict]:
+        try:
+            with urllib.request.urlopen(f"http://{target}{path}",
+                                        timeout=self.timeout_s) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read().decode() or "{}")
+            except Exception:
+                return e.code, {}
+
+    # -- placement ------------------------------------------------------
+    def _fleet_states(self) -> Dict[str, dict]:
+        if self.fleet_view is None:
+            return {}
+        try:
+            return {r.name: {"state": r.state,
+                             "queue_depth": r.queue_depth}
+                    for r in self.fleet_view.replicas()}
+        except Exception:
+            return {}
+
+    def _routable(self) -> List[_RouterRep]:
+        now = self._clock()
+        fleet = self._fleet_states()
+        out = []
+        for rep in self._reps.values():
+            if rep.suspect_until > now or rep.draining_until > now:
+                continue
+            info = fleet.get(rep.name)
+            if info is not None and info["state"] == "down":
+                continue
+            out.append(rep)
+        self._m_routable.set(float(len(out)))
+        return out
+
+    def _depth(self, rep: _RouterRep, fleet: Dict[str, dict]) -> float:
+        info = fleet.get(rep.name)
+        if info is not None and info.get("queue_depth") is not None:
+            return float(info["queue_depth"])
+        return float(len(rep.in_flight))
+
+    def ladder(self, prompt) -> List[Tuple[_RouterRep, int]]:
+        """The ordered placement ladder for this prompt:
+        ``[(replica, sketch_match_tokens), ...]`` best-first."""
+        self._refresh_discovery()
+        with self._lock:
+            cands = self._routable()
+            if not cands:
+                return []
+            fleet = self._fleet_states()
+            if self.policy == "round_robin":
+                start = self._rr_counter % len(cands)
+                self._rr_counter += 1
+                ordered = cands[start:] + cands[:start]
+                return [(r, 0) for r in ordered]
+            match = self.sketch.match_tokens(prompt)
+            return sorted(
+                ((r, match.get(r.name, 0)) for r in cands),
+                key=lambda e: (-e[1], self._depth(e[0], fleet),
+                               e[0].name))
+
+    def _hop_span(self, rr: RoutedRequest, replica: str) -> str:
+        """Mint the next hop's span id (a child of the request's root
+        span) and open its span record; the id rides the forwarded
+        ``traceparent``, so the replica's local root chains under THIS
+        hop."""
+        n = len(rr.spans) + 1
+        span_id = rr.ctx.child_span_id(n)
+        rr.spans.append({
+            "trace_id": rr.ctx.trace_id,
+            "span_id": span_id,
+            "parent_id": rr.ctx.span_id,
+            "name": "hop",
+            "t0_s": time.perf_counter(),
+            "t1_s": None,
+            "attrs": {"replica": replica, "attempt": rr.attempts},
+        })
+        return span_id
+
+    def _close_hop(self, rr: RoutedRequest, outcome: str,
+                   uid: Optional[int] = None) -> None:
+        span = rr.spans[-1]
+        span["t1_s"] = time.perf_counter()
+        span["attrs"]["outcome"] = outcome
+        if uid is not None:
+            span["attrs"]["uid"] = uid
+        rr.hops.append({"replica": span["attrs"]["replica"],
+                        "outcome": outcome, "uid": uid})
+
+    def _try_place(self, rr: RoutedRequest) -> bool:
+        """Walk the ladder; between full-ladder failures back off with
+        seeded jitter.  True = admitted somewhere."""
+        doc = {"prompt": [int(t) for t in rr.prompt], **rr.gen}
+        for round_n in range(self.max_retries + 1):
+            if round_n > 0:
+                self._m_retries.inc()
+                delay = (self.backoff_ms / 1e3) * (2 ** (round_n - 1)) \
+                    * (1.0 + self.jitter * float(self._rng.random()))
+                time.sleep(delay)
+            ladder = self.ladder(rr.prompt)
+            for rep, match in ladder:
+                rr.attempts += 1
+                span_id = self._hop_span(rr, rep.name)
+                tp = (f"00-{rr.ctx.trace_id}-{span_id}-"
+                      f"{'01' if rr.ctx.sampled else '00'}")
+                try:
+                    code, payload = self._post(
+                        rep.serve, "/submit", doc,
+                        headers={"traceparent": tp})
+                except Exception as e:
+                    # transport failure: likely dead — suspect it so the
+                    # rest of this round skips it
+                    self._close_hop(rr, "conn_error")
+                    self._note_conn_failure(rep, repr(e))
+                    continue
+                if code == 200 and "uid" in payload:
+                    uid = int(payload["uid"])
+                    self._close_hop(rr, "admitted", uid)
+                    with self._lock:
+                        rep.placed += 1
+                        rep.conn_fails = 0
+                        rep.in_flight.add(rr.rid)
+                        rr.state = "admitted"
+                        rr.replica = rep.name
+                        rr.uid = uid
+                        rr.t_admitted = time.perf_counter()
+                        self.sketch.note(rr.prompt, rep.name)
+                    self._m_requests.labels(replica=rep.name).inc()
+                    if match > 0:
+                        self._m_match_tokens.inc(match)
+                    return True
+                if code == 503:
+                    # draining: back off from this replica for a while
+                    self._close_hop(rr, "draining")
+                    self._m_sheds.labels(reason="draining").inc()
+                    with self._lock:
+                        rep.draining_until = self._clock() \
+                            + self.drain_cooldown_s
+                    continue
+                reason = str(payload.get("shed")
+                             or payload.get("error") or f"http_{code}")
+                self._close_hop(rr, f"shed:{reason}")
+                self._m_sheds.labels(
+                    reason=_shed_label(code, payload)).inc()
+                with self._lock:
+                    rep.sheds += 1
+        rr.state = "shed"
+        rr.shed_reason = rr.hops[-1]["outcome"] if rr.hops \
+            else "no_routable_replica"
+        self._finish_trace(rr)
+        return False
+
+    def _note_conn_failure(self, rep: _RouterRep, err: str) -> None:
+        with self._lock:
+            rep.conn_fails += 1
+            rep.suspect_until = self._clock() + self.suspect_cooldown_s
+        self._m_sheds.labels(reason="conn_error").inc()
+        logger.warning(f"router: replica {rep.name} ({rep.serve}) "
+                       f"unreachable: {err}")
+
+    # -- the public submit/wait surface ---------------------------------
+    def submit(self, prompt, **gen_kwargs) -> int:
+        """Place one request; returns the router-level request id.
+        A request every routable replica shed lands in
+        :attr:`rejected` — the ``rejected``-outcome discipline, one
+        level up."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        ctx = _reqtrace.TraceContext.from_uid(
+            rid, seed=f"router:{self.seed}", sample=1)
+        rr = RoutedRequest(rid=rid, prompt=prompt, gen=dict(gen_kwargs),
+                           ctx=ctx, t_submit=time.perf_counter())
+        with self._lock:
+            self._requests[rid] = rr
+        self._try_place(rr)
+        return rid
+
+    @property
+    def rejected(self) -> Dict[int, str]:
+        """rid → reason for requests every routable replica shed."""
+        return {rid: rr.shed_reason or "shed"
+                for rid, rr in self._requests.items()
+                if rr.state == "shed"}
+
+    def outstanding(self) -> List[int]:
+        return [rid for rid, rr in self._requests.items()
+                if rr.state in ("placing", "admitted")]
+
+    def _fail_replica(self, rep: _RouterRep, why: str) -> None:
+        """The replica is gone: suspect it, drop its sketch heat (the
+        cache died with it), and re-place every admitted request it
+        held — zero admitted requests lost."""
+        with self._lock:
+            rep.suspect_until = self._clock() + self.suspect_cooldown_s
+            victims = [self._requests[rid] for rid in list(rep.in_flight)
+                       if rid in self._requests]
+            rep.in_flight.clear()
+        dropped = self.sketch.drop_replica(rep.name)
+        logger.warning(
+            f"router: failing over {len(victims)} admitted request(s) "
+            f"from replica {rep.name} ({why}); dropped {dropped} sketch "
+            f"entries")
+        for rr in victims:
+            rr.failovers += 1
+            self._m_failovers.inc()
+            rr.state = "placing"
+            rr.replica = None
+            rr.uid = None
+            self._replace(rr)
+
+    # a runaway re-placement loop (replica flapping faster than the
+    # router can converge, or an admit→async-shed ping-pong under a
+    # deadline) must terminate: past the cap a request is shed, never
+    # silently duplicated forever.  Counts EVERY re-placement — the
+    # async-shed path doesn't increment ``failovers`` (nothing died),
+    # but it must still be bounded.
+    MAX_FAILOVERS = 8
+
+    def _replace(self, rr: RoutedRequest) -> None:
+        rr.unknown_polls = 0
+        rr.replacements += 1
+        if rr.replacements > self.MAX_FAILOVERS:
+            rr.state = "shed"
+            rr.shed_reason = "failover_storm"
+            logger.warning(f"router: rid {rr.rid} exceeded "
+                           f"{self.MAX_FAILOVERS} re-placements; "
+                           f"shedding")
+            self._finish_trace(rr)
+            return
+        self._try_place(rr)
+
+    def poll_once(self) -> int:
+        """One poll sweep: batch-poll every replica holding admitted
+        requests, fold in results, fail over dead replicas.  Returns
+        the number of requests still outstanding."""
+        with self._lock:
+            by_rep = [(rep, sorted(rep.in_flight))
+                      for rep in self._reps.values() if rep.in_flight]
+        for rep, rids in by_rep:
+            uids = ",".join(str(self._requests[rid].uid) for rid in rids
+                            if self._requests[rid].uid is not None)
+            if not uids:
+                continue
+            try:
+                code, payload = self._get(rep.serve,
+                                          f"/results?uids={uids}")
+            except Exception as e:
+                with self._lock:
+                    rep.conn_fails += 1
+                    fails = rep.conn_fails
+                if fails >= self.failover_after:
+                    self._fail_replica(rep, f"poll failed x{fails}: {e!r}")
+                continue
+            if code != 200:
+                continue
+            with self._lock:
+                rep.conn_fails = 0
+            results = payload.get("results") or {}
+            for rid in rids:
+                rr = self._requests.get(rid)
+                if rr is None or rr.state != "admitted" \
+                        or rr.replica != rep.name:
+                    continue
+                res = results.get(str(rr.uid))
+                if not res:
+                    continue
+                status = res.get("status")
+                if status == "done":
+                    with self._lock:
+                        rep.in_flight.discard(rid)
+                        rr.state = "done"
+                        rr.result = res
+                        rr.t_done = time.perf_counter()
+                    self._finish_trace(rr)
+                elif status == "shed":
+                    # admitted then shed asynchronously (deadline sweep,
+                    # queue eviction, drain): re-place like a failover —
+                    # the caller was promised an admitted request
+                    with self._lock:
+                        rep.in_flight.discard(rid)
+                        rr.state = "placing"
+                    self._close_hop_async(rr, rep.name,
+                                          f"async_shed:{res.get('reason')}")
+                    self._replace(rr)
+                elif status == "unknown":
+                    # the replica restarted and lost the uid.  Require
+                    # failover_after CONSECUTIVE unknowns (the conn-
+                    # failure discipline): a single spurious unknown
+                    # must not trigger a duplicate placement.
+                    rr.unknown_polls += 1
+                    if rr.unknown_polls < self.failover_after:
+                        continue
+                    with self._lock:
+                        rep.in_flight.discard(rid)
+                        rr.state = "placing"
+                        rr.failovers += 1
+                    self._m_failovers.inc()
+                    self.sketch.drop_replica(rep.name)
+                    self._replace(rr)
+                else:
+                    rr.unknown_polls = 0
+        return len(self.outstanding())
+
+    def _close_hop_async(self, rr: RoutedRequest, replica: str,
+                         outcome: str) -> None:
+        rr.hops.append({"replica": replica, "outcome": outcome,
+                        "uid": rr.uid})
+
+    def wait(self, rids=None, *, timeout_s: Optional[float] = None,
+             poll_interval_s: float = 0.005) -> Dict[int, np.ndarray]:
+        """Poll until every requested rid is terminal; returns
+        {rid: tokens} for the completed ones (shed rids are terminal
+        and absent — :attr:`rejected` names their reason, the
+        ``ContinuousBatcher.wait`` contract one level up)."""
+        targets = list(self._requests) if rids is None else list(rids)
+        unknown = [r for r in targets if r not in self._requests]
+        if unknown:
+            # the ContinuousBatcher.wait discipline: an unknown handle
+            # can never complete — fail immediately and descriptively,
+            # not with a bare KeyError mid-loop
+            raise RuntimeError(
+                f"rids {unknown} were never returned by submit() — "
+                f"they can never complete")
+        t0 = time.perf_counter()
+        while True:
+            outstanding = [r for r in targets
+                           if self._requests[r].state
+                           in ("placing", "admitted")]
+            if not outstanding:
+                break
+            if timeout_s is not None and \
+                    time.perf_counter() - t0 >= timeout_s:
+                raise TimeoutError(
+                    f"router.wait(timeout_s={timeout_s}) expired with "
+                    f"{len(outstanding)} outstanding rids "
+                    f"{outstanding[:8]}")
+            self.poll_once()
+            time.sleep(poll_interval_s)
+        return {r: np.asarray(self._requests[r].result["tokens"],
+                              np.int32)
+                for r in targets
+                if self._requests[r].state == "done"}
+
+    def cancel(self, rid: int) -> str:
+        rr = self._requests.get(rid)
+        if rr is None:
+            return "unknown"
+        if rr.state == "done":
+            return "done"
+        if rr.state == "shed":
+            return "rejected"
+        if rr.uid is None or rr.replica is None:
+            rr.state = "shed"
+            rr.shed_reason = "cancelled"
+            return "cancelled"
+        rep = self._reps.get(rr.replica)
+        if rep is None:
+            return "unknown"
+        try:
+            _, payload = self._post(rep.serve,
+                                    f"/cancel?uid={rr.uid}", {})
+            return str(payload.get("status", "unknown"))
+        except Exception as e:
+            return f"error:{e!r}"
+
+    # -- tracing + status -----------------------------------------------
+    def _finish_trace(self, rr: RoutedRequest) -> None:
+        t1 = rr.t_done if rr.t_done is not None else time.perf_counter()
+        root = {
+            "trace_id": rr.ctx.trace_id,
+            "span_id": rr.ctx.span_id,
+            "parent_id": None,
+            "name": "route",
+            "t0_s": rr.t_submit,
+            "t1_s": t1,
+            "attrs": {"replica": rr.replica, "attempts": rr.attempts,
+                      "failovers": rr.failovers, "outcome": rr.state},
+        }
+        now_unix = time.time()
+        self._retained.append({
+            "trace_id": rr.ctx.trace_id,
+            "uid": rr.rid,
+            "traceparent": rr.ctx.to_traceparent(),
+            "retained": "router",
+            "slo_ok": None,
+            "n_out": (rr.result or {}).get("n_out"),
+            "ttft_ms": (rr.result or {}).get("ttft_ms"),
+            "tpot_ms": (rr.result or {}).get("tpot_ms"),
+            "t_unix": now_unix,
+            "clock_offset_s": now_unix - time.perf_counter(),
+            "spans": [root] + [s for s in rr.spans
+                               if s["t1_s"] is not None],
+        })
+
+    def tracez(self) -> dict:
+        """The router's retained span trees in the ``/tracez?full=1``
+        payload shape — hand it to :func:`fleet.stitch_tracez` as one
+        more "replica" (conventionally named ``router``) to see
+        router→replica spans under one trace id."""
+        with self._lock:
+            traces = [dict(t) for t in reversed(self._retained)]
+        return {"enabled": True, "retained": [], "traces": traces}
+
+    def per_replica(self) -> Dict[str, dict]:
+        """Per-replica rollup for reports: placements, sheds seen,
+        current in-flight, routability."""
+        now = self._clock()
+        with self._lock:
+            return {rep.name: {
+                "target": rep.serve,
+                "placed": rep.placed,
+                "sheds": rep.sheds,
+                "in_flight": len(rep.in_flight),
+                "suspect": rep.suspect_until > now,
+                "draining": rep.draining_until > now,
+            } for rep in self._reps.values()}
+
+    def _status(self) -> dict:
+        with self._lock:
+            states = {"placing": 0, "admitted": 0, "done": 0, "shed": 0}
+            for rr in self._requests.values():
+                states[rr.state] = states.get(rr.state, 0) + 1
+        return {
+            "policy": self.policy,
+            "replicas": self.per_replica(),
+            "requests": states,
+            "sketch_entries": len(self.sketch),
+            "sketch_block_tokens": self.sketch.block_tokens,
+        }
+
+
+# ---------------------------------------------------------------------------
+# routed replay (the measurement harness scripts/loadgen.py --router uses)
+# ---------------------------------------------------------------------------
+
+def replay_routed(router: Router, trace, slo, *, time_scale: float = 1.0,
+                  kill_at: Optional[int] = None,
+                  kill_fn: Optional[Callable[[], None]] = None,
+                  timeout_s: float = 300.0):
+    """Replay a ``telemetry/loadgen.py`` trace through a :class:`Router`
+    in open loop and report goodput under ``slo`` with per-request
+    replica attribution.
+
+    TTFT is arrival-anchored like ``loadgen.replay``: router-side
+    placement lag (arrival → admitted) plus the replica-reported
+    submit→first-token TTFT.  ``kill_at``/``kill_fn`` arm the failover
+    test: the first time some replica holds ``kill_at`` admitted
+    requests IN FLIGHT, ``kill_fn()`` runs (typically
+    ``ReplicaServer.kill`` of that busiest replica — killing one with
+    nothing in flight would prove nothing) and the replay continues —
+    the report's ``failovers``/``lost`` fields say whether every
+    admitted request still completed.  Returns a
+    ``loadgen.LoadReport`` whose waterfalls carry a ``replica`` column
+    and whose ``per_replica`` rollup maps each replica to requests /
+    hit tokens / sheds."""
+    from ..telemetry import loadgen as _loadgen
+
+    judge = slo if slo is not None else _loadgen.SLOConfig(
+        ttft_ms=1e12, tpot_ms=1e12)
+    reqs = sorted(trace.requests, key=lambda r: r.arrival_s)
+    rid_by_idx: Dict[int, int] = {}
+    t0 = time.perf_counter()
+    killed = False
+    i, n = 0, len(reqs)
+    while i < n or router.outstanding():
+        now_v = (time.perf_counter() - t0) * time_scale
+        while i < n and reqs[i].arrival_s <= now_v:
+            r = reqs[i]
+            rid_by_idx[r.idx] = router.submit(
+                r.prompt, max_new_tokens=r.max_new_tokens)
+            i += 1
+        router.poll_once()
+        if not killed and kill_fn is not None and kill_at is not None:
+            busiest = max((info["in_flight"]
+                           for info in router.per_replica().values()),
+                          default=0)
+            if busiest >= kill_at:
+                killed = True
+                kill_fn()
+        if time.perf_counter() - t0 > timeout_s:
+            raise TimeoutError(
+                f"routed replay exceeded {timeout_s}s with "
+                f"{len(router.outstanding())} outstanding")
+        if i < n or router.outstanding():
+            time.sleep(0.002)
+    wall = time.perf_counter() - t0
+
+    waterfalls: List[dict] = []
+    records: List[dict] = []
+    per_replica: Dict[str, dict] = {
+        name: {"requests": 0, "hit_tokens": 0, "prefill_tokens": 0,
+               "sheds": info["sheds"], "failovers": 0}
+        for name, info in router.per_replica().items()}
+    completed = rejected = lost = failovers = 0
+    for r in reqs:
+        rid = rid_by_idx.get(r.idx)
+        rr = router._requests.get(rid) if rid is not None else None
+        w = {"uid": rid, "idx": r.idx,
+             "arrival_s": round(r.arrival_s, 6),
+             "shared_prefix": r.shared_prefix}
+        if rr is None:
+            waterfalls.append(w)
+            records.append({"n_out": 0, "ttft_ms": float("inf"),
+                            "tpot_ms": None})
+            continue
+        w["replica"] = rr.replica
+        w["attempts"] = rr.attempts
+        if rr.failovers:
+            w["failovers"] = rr.failovers
+            failovers += rr.failovers
+        if rr.state == "shed":
+            w["rejected"] = rr.shed_reason or "shed"
+            rejected += 1
+            waterfalls.append(w)
+            records.append({"n_out": 0, "ttft_ms": float("inf"),
+                            "tpot_ms": None, "rejected": True})
+            continue
+        if rr.state != "done" or rr.result is None:
+            lost += 1          # admitted but never completed: a LOST
+            waterfalls.append(w)   # request — the failover invariant
+            records.append({"n_out": 0, "ttft_ms": float("inf"),
+                            "tpot_ms": None})
+            continue
+        res = rr.result
+        completed += 1
+        # arrival-anchored TTFT: time from the TRACE arrival to the
+        # LAST admission (covers router submit lag, ladder walks,
+        # backoff sleeps, and the whole dead-replica detection +
+        # failover interval — anchoring on submit() entry would hide
+        # exactly the placement cost being measured) plus the admitting
+        # replica's own submit→first-token TTFT
+        arr_rel = r.arrival_s / time_scale
+        t_anchor = rr.t_admitted if rr.t_admitted is not None \
+            else rr.t_submit
+        lag_ms = 1e3 * max(0.0, (t_anchor - t0) - arr_rel)
+        rep_ttft = res.get("ttft_ms")
+        ttft = (lag_ms + float(rep_ttft)) if rep_ttft is not None \
+            else float("inf")
+        n_out = int(res.get("n_out") or 0)
+        tpot = res.get("tpot_ms")
+        w.update({"n_out": n_out, "ttft_ms": round(ttft, 3),
+                  "tpot_ms": tpot,
+                  "hit_tokens": int(res.get("hit_tokens") or 0),
+                  "prefix_hit_tokens": int(res.get("hit_tokens") or 0),
+                  "prefill_tokens": int(res.get("prefill_tokens") or 0),
+                  "queued_s": None, "prefill_s": None, "decode_s": None,
+                  "slo_ok": bool(n_out > 0 and ttft <= judge.ttft_ms
+                                 and (tpot is None
+                                      or tpot <= judge.tpot_ms))})
+        if rr.replica in per_replica:
+            pr = per_replica[rr.replica]
+            pr["requests"] += 1
+            pr["hit_tokens"] += w["hit_tokens"]
+            pr["prefill_tokens"] += w["prefill_tokens"]
+            pr["failovers"] += rr.failovers
+        waterfalls.append(w)
+        records.append({"n_out": n_out, "ttft_ms": ttft, "tpot_ms": tpot})
+    g = _loadgen.compute_goodput(records, judge, wall)
+    hit = sum(w.get("hit_tokens", 0) for w in waterfalls)
+    pf = sum(w.get("prefill_tokens", 0) for w in waterfalls)
+    # dstpu-lint: disable-next-line=DSTPU006 -- report JSON key (the routed-arm comparison's numerator), not a registry metric; the scrapeable per-replica signal is prefix_cache_hit_tokens_total
+    g["prefix_hit_token_ratio"] = \
+        round(hit / (hit + pf), 6) if hit + pf else None
+    report = _loadgen.LoadReport(
+        trace_sha256=trace.sha256(),
+        trace_config=dataclasses.asdict(trace.config),
+        slo=judge.to_jsonable(), wall_s=round(wall, 4), goodput=g,
+        waterfalls=waterfalls, queue_timeline=[], phases={},
+        completed=completed, offered=len(reqs), rejected=rejected,
+        per_replica=per_replica)
+    report.routed = {"policy": router.policy, "lost": lost,
+                     "failovers": failovers,
+                     "hit_tokens": hit, "prefill_tokens": pf}
+    return report

@@ -1880,6 +1880,42 @@ class ContinuousBatcher:
         return {u: self._finished[u] for u in targets
                 if u in self._finished}
 
+    def cancel(self, uid: int) -> str:
+        """Best-effort cancel (the ``/cancel`` route of the per-replica
+        serve endpoint).  A queued request is shed (``rejected``
+        outcome, reason ``cancelled``); a parked or slotted request is
+        finished IMMEDIATELY with its partial output through the
+        normal retire/donate discipline (slot freed, paged KV
+        returned) — the drain-force semantics, per request.  Returns
+        one of ``cancelled`` / ``finished_partial`` / ``done`` /
+        ``rejected`` (already terminal) / ``unknown``."""
+        if uid in self._finished:
+            return "done"
+        if uid in self._rejected:
+            return "rejected"
+        for r in self._queue:
+            if r.uid == uid:
+                self._queue.remove(r)
+                self._reject_queued(r, "cancelled")
+                self._update_occupancy_gauges()
+                return "cancelled"
+        for entry in list(self._parked):
+            if entry[0].uid == uid:
+                self._parked.remove(entry)
+                if self.paged is not None:
+                    meta = self._parked_meta.pop(uid, None)
+                    if meta is not None:
+                        self.paged.finish_unslotted(meta, entry[0].prompt)
+                self._finish_unslotted(entry[0], [entry[5]])
+                self._shrink_parked()
+                return "finished_partial"
+        for i, act in enumerate(self._slots):
+            if act is not None and act.req.uid == uid:
+                self._retire(i)
+                self._update_occupancy_gauges()
+                return "finished_partial"
+        return "unknown"
+
     def run(self, prompts, ticks: int = 1,
             timeout_s: Optional[float] = None,
             **gen_kwargs) -> List[Optional[np.ndarray]]:

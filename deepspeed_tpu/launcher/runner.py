@@ -33,6 +33,11 @@ from typing import Optional
 from ..utils.logging import logger
 
 _DISCOVERY_RE = re.compile(r"^telemetry_rank(\d+)\.json$")
+# per-replica serve endpoints (inference/router.py ReplicaServer):
+# merged into each fleet.json entry as "serve_port" so a router can
+# discover where to POST — alongside the telemetry port a FleetView
+# scrapes
+_SERVE_DISCOVERY_RE = re.compile(r"^serve_rank(\d+)\.json$")
 
 
 def _reset_fleet_discovery(metrics_dir: Optional[str]) -> None:
@@ -42,7 +47,8 @@ def _reset_fleet_discovery(metrics_dir: Optional[str]) -> None:
     if not metrics_dir or not os.path.isdir(metrics_dir):
         return
     for fn in os.listdir(metrics_dir):
-        if _DISCOVERY_RE.match(fn) or fn == "fleet.json":
+        if _DISCOVERY_RE.match(fn) or _SERVE_DISCOVERY_RE.match(fn) \
+                or fn == "fleet.json":
             try:
                 os.remove(os.path.join(metrics_dir, fn))
             except OSError:
@@ -59,11 +65,21 @@ def _update_fleet_discovery(metrics_dir: str, state: dict,
     actually changes; ``state`` carries the last-written signature
     across calls."""
     entries = []
+    serve_ports = {}
     try:
         names = os.listdir(metrics_dir)
     except OSError:
         return
     for fn in names:
+        sm = _SERVE_DISCOVERY_RE.match(fn)
+        if sm:
+            try:
+                with open(os.path.join(metrics_dir, fn)) as fh:
+                    sdoc = json.load(fh)
+                serve_ports[int(sm.group(1))] = int(sdoc["port"])
+            except Exception:
+                pass            # torn/partial file: pick it up next pass
+            continue
         m = _DISCOVERY_RE.match(fn)
         if not m:
             continue
@@ -75,8 +91,12 @@ def _update_fleet_discovery(metrics_dir: str, state: dict,
                             "pid": doc.get("pid")})
         except Exception:
             continue            # torn/partial file: pick it up next pass
+    for e in entries:
+        if e["rank"] in serve_ports:
+            e["serve_port"] = serve_ports[e["rank"]]
     entries.sort(key=lambda e: e["rank"])
-    sig = tuple((e["rank"], e["host"], e["port"], e["pid"])
+    sig = tuple((e["rank"], e["host"], e["port"], e["pid"],
+                 e.get("serve_port"))
                 for e in entries)
     if sig == state.get("sig"):
         return

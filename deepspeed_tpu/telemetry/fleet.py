@@ -921,22 +921,44 @@ class FleetView:
 
     def best_for_prefix(self, counters: Sequence[str] = (
             "prefix_cache_hit_tokens_total",)) -> Optional[ReplicaInfo]:
-        """The replica a prefix-cache-aware router should prefer: the
-        routable (healthy/degraded) replica with the highest sum of the
-        named hit counters — the ``kvreuse`` counters make cache
-        residency measurable without shipping radix-tree contents.
-        Ties break toward the shallower queue."""
+        """The replica a prefix-cache-aware router should prefer.
+
+        Ranking contract, in order:
+
+        1. **Reporting beats absent.**  A replica where every named
+           counter is ABSENT from the scrape ranks below any replica
+           that reports one — even a reported zero.  A restarted
+           replica hasn't registered the counter family yet, so its
+           cache heat is UNKNOWN, not zero; before this rule a fresh
+           replica sorted EQUAL to a known-cold one and the
+           queue-depth tie-break could route prefix traffic at a cache
+           that provably holds nothing.  (When every candidate is
+           absent — a whole-fleet restart — the rule is vacuous and
+           ranking falls through to the tie-break.)
+        2. **Higher summed hit counters win** among reporting replicas
+           — the ``kvreuse`` counters make cache residency measurable
+           without shipping radix-tree contents.  Note this is a
+           GLOBAL heat signal (total hit tokens, not per-prefix); the
+           serving router's ``PrefixSketch`` upgrades it to per-prefix
+           placement.
+        3. **Ties break toward the shallower queue.**
+
+        Only routable (healthy/degraded) replicas are considered;
+        never returns a ``down`` replica."""
         with self._lock:
             cands = [r for r in self._reps.values()
                      if r.health.state in ("healthy", "degraded")]
             if not cands:
                 return None
-            best = max(
-                cands,
-                key=lambda r: (
-                    sum(r.counter_total(c) for c in counters),
-                    -(metric_total(r.metrics, "serving_queue_depth")
-                      or 0.0)))
+
+            def rank(r: _Rep):
+                totals = [metric_total(r.metrics, c) for c in counters]
+                known = [t for t in totals if t is not None]
+                return (1 if known else 0, sum(known),
+                        -(metric_total(r.metrics, "serving_queue_depth")
+                          or 0.0))
+
+            best = max(cands, key=rank)
             return self._replica_info(best)
 
     def _total_queue_locked(self) -> float:

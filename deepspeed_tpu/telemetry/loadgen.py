@@ -444,6 +444,12 @@ class LoadReport:
     completed: int
     offered: int
     rejected: int = 0          # shed at admission (final — post-retry)
+    # routed runs (inference/router.py replay_routed): per-replica
+    # rollup {name: {requests, hit_tokens, prefill_tokens, sheds,
+    # failovers}} and the routed-arm summary (policy, lost, failovers).
+    # None for plain single-batcher replays — absent from their tables.
+    per_replica: Optional[Dict[str, dict]] = None
+    routed: Optional[dict] = None
 
     def to_jsonable(self) -> dict:
         return dataclasses.asdict(self)
@@ -482,6 +488,25 @@ class LoadReport:
         if self.queue_timeline:
             peak = max(s["queued"] for s in self.queue_timeline)
             lines.append(f"peak queue depth: {peak}")
+        if self.routed:
+            lines.append(
+                f"routed ({self.routed.get('policy')}): "
+                f"failovers {self.routed.get('failovers', 0)}, lost "
+                f"{self.routed.get('lost', 0)}, hit-token ratio "
+                # dstpu-lint: disable-next-line=DSTPU006 -- report JSON key read-back, not a registry metric
+                f"{g.get('prefix_hit_token_ratio')}")
+        if self.per_replica:
+            lines.append(f"{'replica':<10} {'requests':>9} "
+                         f"{'hit_tok':>8} {'prefill_tok':>12} "
+                         f"{'sheds':>6} {'failovers':>10}")
+            for name in sorted(self.per_replica):
+                pr = self.per_replica[name]
+                lines.append(
+                    f"{name:<10} {pr.get('requests', 0):>9} "
+                    f"{pr.get('hit_tokens', 0):>8} "
+                    f"{pr.get('prefill_tokens', 0):>12} "
+                    f"{pr.get('sheds', 0):>6} "
+                    f"{pr.get('failovers', 0):>10}")
         return "\n".join(lines)
 
     def format_waterfalls(self, limit: int = 8,
@@ -494,8 +519,12 @@ class LoadReport:
         slowest-TTFT table IS the index into "why was this one slow"."""
         done = [w for w in self.waterfalls if w.get("ttft_ms") is not None]
         done.sort(key=lambda w: -w["ttft_ms"])
+        # routed replays attribute each request to the replica that
+        # served it — surface the column whenever any row carries one
+        routed = any(w.get("replica") for w in self.waterfalls)
         lines = [f"{'uid':>5} {'queued':>9} {'prefill':>9} {'decode':>9} "
                  f"{'ttft_ms':>9} {'tpot_ms':>9} {'tok':>5} {'hit':>5} slo"
+                 + ("  replica" if routed else "")
                  + ("  trace" if links else "")]
         for w in done[:limit]:
             def ms(x):
@@ -508,6 +537,7 @@ class LoadReport:
                 f"{w.get('n_out', 0):>5} "
                 f"{w.get('prefix_hit_tokens', 0):>5} "
                 f"{'ok' if w.get('slo_ok') else 'VIOL'}"
+                + (f"  {w.get('replica') or '-'}" if routed else "")
                 + (f"  {links.get(w['uid'], '-')}" if links else ""))
         rej = [w for w in self.waterfalls if w.get("rejected")]
         if rej:

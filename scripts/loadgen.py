@@ -128,6 +128,35 @@ def parse_args(argv=None):
     ap.add_argument("--chaos-assert-fired", action="store_true",
                     help="fail (exit 1) unless every site named by the "
                          "chaos plan actually fired")
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="replay through an in-process N-replica fleet "
+                         "(inference/router.py: N ContinuousBatchers "
+                         "behind ReplicaServers behind one Router) "
+                         "instead of a single batcher; implies "
+                         "--prefix-cache (per-replica radix caches are "
+                         "what placement affinity feeds)")
+    ap.add_argument("--router-policy",
+                    choices=["affinity", "round_robin", "compare"],
+                    default="compare",
+                    help="placement policy for --router runs; 'compare' "
+                         "replays the SAME trace under both and reports "
+                         "prefix-affinity vs round-robin side by side")
+    ap.add_argument("--router-kill", action="store_true",
+                    help="failover arm (with --router): kill one "
+                         "replica mid-replay and verify every admitted "
+                         "request still completes via router failover "
+                         "(zero lost, zero leaked pages/slots on "
+                         "survivors)")
+    ap.add_argument("--router-block-tokens", type=int, default=None,
+                    help="router prefix-sketch block size (default: the "
+                         "replica caches' page_tokens, so sketch heat "
+                         "aligns with what the caches can serve)")
+    ap.add_argument("--router-assert", action="store_true",
+                    help="turn the --router comparison/failover "
+                         "verdicts into exit-code gates (CI): affinity "
+                         "must strictly beat round-robin on prefix hit-"
+                         "token ratio, and the kill arm must lose zero "
+                         "admitted requests")
     ap.add_argument("--gate", default=None, metavar="BASELINE.json",
                     help="regression-gate mode against this baseline")
     ap.add_argument("--record-baseline", default=None, metavar="PATH",
@@ -148,14 +177,15 @@ def trace_config(args, loadgen, vocab_size: int):
         vocab_size=vocab_size, max_total_len=args.max_total)
 
 
-def build_batcher(args):
-    """gpt2-family engine + batcher sized for the trace (CPU-mesh
-    friendly: gpt2-tiny compiles in seconds)."""
+def build_engine(args):
+    """gpt2-family inference engine sized for the trace (CPU-mesh
+    friendly: gpt2-tiny compiles in seconds).  One engine can back
+    SEVERAL batchers (the --router fleet shares it so params and the
+    engine-level prefill executables exist once)."""
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
-    from deepspeed_tpu.inference.serving import ContinuousBatcher
     from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
 
     cfg = gpt2_config(args.model, dtype=jnp.float32)
@@ -168,6 +198,16 @@ def build_batcher(args):
     eng = deepspeed_tpu.init_inference(model=model, dtype=jnp.float32,
                                        params=params,
                                        max_tokens=args.max_total)
+    return eng, cfg
+
+
+def build_batcher(args, eng=None):
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+
+    if eng is None:
+        eng, cfg = build_engine(args)
+    else:
+        cfg = eng.model_cfg
     admission = None
     if getattr(args, "admission", False):
         admission = {"max_queue_depth": getattr(args, "max_queue_depth",
@@ -262,6 +302,194 @@ def run_load(args, trace_cfg, calibration=None):
             chaos_mod.clear()
         chaos_result = (chaos_report, fired, batcher.leak_counts())
     return best, reports, slo, tracer, chaos_result
+
+
+def _build_fleet(args, eng, n, trace, ticks):
+    """N fresh batchers (own radix prefix cache each — per-replica
+    cache heat is the signal being measured) behind started
+    ReplicaServers; each batcher warmed before its server loop runs."""
+    import numpy as np
+
+    from deepspeed_tpu.inference.router import ReplicaServer
+    from deepspeed_tpu.inference.serving import ContinuousBatcher
+
+    # a NEUTRAL warm prompt, deliberately not a trace prompt: warming
+    # with a shared-prefix member would pre-seed the shared prefix into
+    # EVERY replica's radix cache and erase the very affinity-vs-round-
+    # robin difference being measured.  Same length bucket as the trace
+    # prompts so the prefill executables still pre-compile.
+    warm_len = max(len(r.prompt) for r in trace.requests)
+    warm = (np.arange(warm_len, dtype=np.int32) * 7 + 3) \
+        % trace.config.vocab_size
+    admission = None
+    if getattr(args, "admission", False):
+        # --admission applies per REPLICA (each batcher runs its own
+        # controller) — routed 429s then exercise the shed→next-rung
+        # path for real
+        admission = {"max_queue_depth": getattr(args, "max_queue_depth",
+                                                16)}
+        if getattr(args, "deadline_ms", None) is not None:
+            admission["deadline_ms"] = args.deadline_ms
+    servers = []
+    for k in range(n):
+        b = ContinuousBatcher(eng, n_slots=args.slots, prefix_cache={},
+                              admission=dict(admission)
+                              if admission else None)
+        b.run([warm], max_new_tokens=4, ticks=ticks)
+        b.warmup_windows(ticks)
+        servers.append(ReplicaServer(b, ticks=ticks, name=f"r{k}",
+                                     rank=k).start())
+    return servers
+
+
+def run_router_mode(args) -> int:
+    """--router N: replay the trace through an in-process N-replica
+    fleet and report prefix-affinity vs round-robin placement (hit-
+    token ratio, TTFT p99, goodput) plus the kill-one-replica failover
+    arm.  Fresh batchers per arm — arms must not inherit each other's
+    cache heat or the comparison is meaningless."""
+    from deepspeed_tpu.inference.router import Router, replay_routed
+    from deepspeed_tpu.telemetry import loadgen
+
+    n = max(2, args.router)
+    args.prefix_cache = True          # affinity routes AT the caches
+    # flags the routed path does not implement must fail or warn, never
+    # silently report clean numbers the user believes were faulted
+    unsupported = [f for f, v in (("--chaos", args.chaos),
+                                  ("--retries", args.retries),
+                                  ("--trace-out", args.trace_out),
+                                  ("--gate", args.gate))
+                   if v]
+    if unsupported:
+        print(f"error: {', '.join(unsupported)} not supported with "
+              f"--router (the router has its own retry ladder; chaos/"
+              f"trace-out/gate cover the single-batcher path)",
+              file=sys.stderr)
+        return 2
+    cfg = trace_config(args, loadgen, vocab_size=512)
+    if args.shared_prefix_len < 17 and args.router_block_tokens is None:
+        print(f"note: --shared-prefix-len {args.shared_prefix_len} is "
+              f"below the replica caches' 16-token page size — shared "
+              f"prompts will produce ZERO cache hits and the affinity/"
+              f"round-robin comparison will be vacuous; use "
+              f"--shared-prefix-len >= 17")
+    trace = loadgen.generate_trace(cfg)
+    eng, _ = build_engine(args)
+
+    # calibrate once on a throwaway single batcher (machine-relative
+    # SLO bounds, the run_load discipline)
+    if args.slo_ttft_ms is not None and args.slo_tpot_ms is not None:
+        slo = loadgen.SLOConfig(ttft_ms=args.slo_ttft_ms,
+                                tpot_ms=args.slo_tpot_ms)
+    else:
+        cal_b, _ = build_batcher(args, eng)
+        cal_b.run([trace.requests[0].prompt], max_new_tokens=4,
+                  ticks=args.ticks)
+        cal_b.warmup_windows(args.ticks)
+        cal = loadgen.calibrate_slo(cal_b, **_CALIBRATION)
+        slo = loadgen.SLOConfig(
+            ttft_ms=cal.ttft_ms if args.slo_ttft_ms is None
+            else args.slo_ttft_ms,
+            tpot_ms=cal.tpot_ms if args.slo_tpot_ms is None
+            else args.slo_tpot_ms)
+
+    def run_arm(policy, kill=False):
+        servers = _build_fleet(args, eng, n, trace, args.ticks)
+        bt = args.router_block_tokens
+        if bt is None:
+            pc = servers[0].batcher.prefix_cache
+            bt = pc.page_tokens if pc is not None else 16
+        router = Router(
+            replicas={s.name: s.target for s in servers},
+            policy=policy, block_tokens=bt, seed=args.seed)
+        kill_fn = None
+        kill_at = None
+        if kill:
+            # kill the replica that holds the most admitted in-flight
+            # work at trigger time — killing an idle one proves nothing
+            kill_at = 2
+
+            def kill_fn():
+                per = router.per_replica()
+                name = max(per, key=lambda n: per[n]["in_flight"])
+                next(s for s in servers if s.name == name).kill()
+        try:
+            report = replay_routed(router, trace, slo,
+                                   time_scale=args.time_scale,
+                                   kill_at=kill_at, kill_fn=kill_fn)
+        finally:
+            leaks = {s.name: s.batcher.leak_counts()
+                     for s in servers if not s._killed}
+            for s in servers:
+                if not s._killed:
+                    s.stop()
+        report.routed["leaks"] = leaks
+        return report
+
+    arms = {}
+    policies = ["affinity", "round_robin"] \
+        if args.router_policy == "compare" else [args.router_policy]
+    for policy in policies:
+        print(f"\n=== routed replay: {n} replicas, policy={policy} ===")
+        arms[policy] = run_arm(policy)
+        print(arms[policy].table())
+        print(arms[policy].format_waterfalls(args.waterfalls))
+    if args.router_kill:
+        print(f"\n=== failover arm: {n} replicas, kill r{n - 1} "
+              f"mid-replay ===")
+        arms["failover"] = run_arm("affinity", kill=True)
+        print(arms["failover"].table())
+        print(arms["failover"].format_waterfalls(args.waterfalls))
+
+    rc = 0
+    verdict = {}
+    if "affinity" in arms and "round_robin" in arms:
+        a = arms["affinity"].goodput.get("prefix_hit_token_ratio") or 0.0
+        r = arms["round_robin"].goodput.get("prefix_hit_token_ratio") \
+            or 0.0
+        verdict["affinity_hit_token_ratio"] = a
+        verdict["round_robin_hit_token_ratio"] = r
+        verdict["affinity_beats_round_robin"] = a > r
+        print(f"\nprefix hit-token ratio: affinity {a:.4f} vs "
+              f"round-robin {r:.4f} -> "
+              f"{'affinity WINS' if a > r else 'NO WIN'}")
+        print(f"TTFT p99: affinity "
+              f"{arms['affinity'].goodput['ttft_p99_ms']:.1f} ms vs "
+              f"round-robin "
+              f"{arms['round_robin'].goodput['ttft_p99_ms']:.1f} ms")
+        if args.router_assert and not a > r:
+            print("ROUTER FAIL: affinity placement did not strictly "
+                  "beat round-robin on prefix hit-token ratio",
+                  file=sys.stderr)
+            rc = 1
+    if "failover" in arms:
+        fo = arms["failover"].routed
+        verdict["failover_lost"] = fo["lost"]
+        verdict["failover_failovers"] = fo["failovers"]
+        verdict["failover_leaks"] = fo["leaks"]
+        leaked = any(any(v.values()) for v in fo["leaks"].values())
+        print(f"failover: {fo['failovers']} request(s) re-placed, "
+              f"{fo['lost']} lost, survivor leaks {fo['leaks']}")
+        if args.router_assert and (fo["lost"] or leaked
+                                   or fo["failovers"] < 1):
+            print(f"ROUTER FAIL: failover arm lost {fo['lost']} "
+                  f"admitted request(s) / leaked {fo['leaks']} / "
+                  f"{fo['failovers']} failovers", file=sys.stderr)
+            rc = 1
+    if args.report:
+        payload = {name: rep.to_jsonable() for name, rep in arms.items()}
+        payload["verdict"] = verdict
+        payload["runner"] = {"model": args.model, "slots": args.slots,
+                             "ticks": args.ticks, "replicas": n,
+                             "argv": sys.argv[1:]}
+        d = os.path.dirname(args.report)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.report, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"routed report written: {args.report}")
+    print("routed replay: " + ("PASS" if rc == 0 else "FAIL"))
+    return rc
 
 
 def write_traces(out_dir, tracer):
@@ -383,6 +611,9 @@ def main(argv=None) -> int:
                           **trace.to_jsonable()},
                          sort_keys=True, indent=1))
         return 0
+
+    if args.router:
+        return run_router_mode(args)
 
     if args.gate:
         with open(args.gate) as fh:

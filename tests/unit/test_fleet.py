@@ -401,6 +401,30 @@ def test_fleetview_down_excluded_from_seam():
     assert [e["state"] for e in evs] == ["firing"]
 
 
+def test_best_for_prefix_reported_zero_beats_absent_counter():
+    # ranking contract rule 1: a replica REPORTING a zero hit counter
+    # (known-cold cache) outranks one whose counter family is ABSENT
+    # from the scrape (a fresh restart — its heat is UNKNOWN, not
+    # zero), even when the fresh one has the shallower queue that used
+    # to win the tie between "absent" and "zero"
+    cold = Registry()
+    cold.counter("prefix_cache_hit_tokens_total")    # declared, zero
+    cold.gauge("serving_queue_depth").set(6)
+    fresh = Registry()                               # restarted: absent
+    fresh.gauge("serving_queue_depth").set(0)
+    v = _FakeFleet({"cold:1": cold, "fresh:2": fresh})
+    v.scrape_once()
+    assert v.best_for_prefix().name == "cold:1"
+    # whole-fleet restart (every candidate absent): rule 1 is vacuous
+    # and the queue-depth tie-break decides
+    a, b = Registry(), Registry()
+    a.gauge("serving_queue_depth").set(4)
+    b.gauge("serving_queue_depth").set(1)
+    v2 = _FakeFleet({"x:1": a, "y:2": b})
+    v2.scrape_once()
+    assert v2.best_for_prefix().name == "y:2"
+
+
 def test_federated_metrics_shared_family_names_merge():
     # the aggregator process itself exports goodput_ratio/alerts_total
     # (it imports the telemetry package) — replica series under the

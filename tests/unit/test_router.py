@@ -1,0 +1,436 @@
+"""Host-only units for the multi-replica serving router
+(``inference/router.py``): radix-sketch affinity + staleness decay,
+down/draining exclusion, the retry ladder ordering + backoff rounds,
+failover of admitted requests, and traceparent hop chaining into a
+stitched cross-replica trace.  No jax compute — a fake transport stands
+in for the replica endpoints, so the whole file runs in ~a second."""
+import json
+import os
+
+import numpy as np
+
+from deepspeed_tpu.inference.router import (PrefixSketch, Router,
+                                            _shed_label,
+                                            write_serve_discovery)
+from deepspeed_tpu.telemetry import fleet, reqtrace
+
+
+# ----------------------------------------------------------------------
+# fakes
+# ----------------------------------------------------------------------
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _FakeReplica:
+    """One fake serve endpoint: scripted submit behavior + a result
+    store the test completes by hand."""
+
+    def __init__(self, name, mode="admit"):
+        self.name = name
+        self.mode = mode            # admit | shed:<reason> | drain | dead
+        self.submits = []           # (doc, traceparent) per POST /submit
+        self.polls = 0
+        self.next_uid = 100
+        self.results = {}           # uid -> /result payload
+
+    def post(self, path, doc, headers):
+        if self.mode == "dead":
+            raise OSError("connection refused")
+        if path.startswith("/cancel"):
+            return 200, {"status": "cancelled"}
+        self.submits.append((doc, headers.get("traceparent")))
+        if self.mode == "drain":
+            return 503, {"shed": "draining", "replica": self.name}
+        if self.mode.startswith("shed:"):
+            return 429, {"shed": self.mode.split(":", 1)[1],
+                         "replica": self.name}
+        uid = self.next_uid
+        self.next_uid += 1
+        self.results[uid] = {"status": "pending"}
+        return 200, {"uid": uid, "replica": self.name, "queued": 0}
+
+    def get(self, path):
+        if self.mode == "dead":
+            raise OSError("connection refused")
+        self.polls += 1
+        uids = [int(u) for u in
+                path.split("uids=")[1].split(",") if u]
+        return 200, {"results": {
+            str(u): self.results.get(u, {"status": "unknown"})
+            for u in uids}}
+
+    def finish(self, uid, tokens=(1, 2, 3), **extra):
+        self.results[uid] = {"status": "done",
+                             "tokens": list(tokens), "n_out": 2,
+                             "ttft_ms": 5.0, "tpot_ms": 1.0,
+                             "hit_tokens": extra.pop("hit_tokens", 0),
+                             "prefill_tokens": extra.pop(
+                                 "prefill_tokens", 8), **extra}
+
+    def finish_all(self):
+        for uid, res in list(self.results.items()):
+            if res.get("status") == "pending":
+                self.finish(uid)
+
+
+class _FakeRouter(Router):
+    def __init__(self, fakes, **kw):
+        self._fakes = {r.name: r for r in fakes}
+        kw.setdefault("backoff_ms", 0.1)     # keep retry tests fast
+        kw.setdefault("block_tokens", 4)
+        super().__init__(replicas={r.name: r.name for r in fakes}, **kw)
+
+    def _post(self, target, path, doc, headers=None):
+        return self._fakes[target].post(path, doc, headers or {})
+
+    def _get(self, target, path):
+        return self._fakes[target].get(path)
+
+
+class _FakeFleetView:
+    """Duck-typed fleet seam: .replicas() rows with name/state/depth."""
+
+    class _Row:
+        def __init__(self, name, state, depth):
+            self.name, self.state, self.queue_depth = name, state, depth
+
+    def __init__(self, rows):
+        self.rows = rows
+
+    def replicas(self):
+        return [self._Row(*r) for r in self.rows]
+
+
+def _prompt(*blocks):
+    """Concatenate 4-token blocks (the test block size)."""
+    return np.concatenate([np.full(4, b, np.int32) for b in blocks])
+
+
+# ----------------------------------------------------------------------
+# the sketch
+# ----------------------------------------------------------------------
+def test_sketch_match_depth_and_chain_break():
+    clk = _FakeClock()
+    s = PrefixSketch(block_tokens=4, decay_s=60.0, clock=clk)
+    p = _prompt(1, 2, 3)
+    s.note(p, "r0")
+    assert s.match_tokens(p) == {"r0": 12}
+    # shared first block only -> 4 matched tokens
+    assert s.match_tokens(_prompt(1, 9, 9)) == {"r0": 4}
+    # no shared prefix -> no match; partial block never matches
+    assert s.match_tokens(_prompt(7)) == {}
+    assert s.match_tokens(np.full(3, 1, np.int32)) == {}
+    # a deeper note by another replica: deepest fresh entry per chain
+    # wins, shallower entries still credit their replica
+    s.note(_prompt(1, 2, 3, 4), "r1")
+    m = s.match_tokens(_prompt(1, 2, 3, 4))
+    assert m["r1"] == 16
+    assert len(s) > 0
+
+
+def test_sketch_staleness_decay_and_drop():
+    clk = _FakeClock()
+    s = PrefixSketch(block_tokens=4, decay_s=10.0, clock=clk)
+    s.note(_prompt(1, 2), "r0")
+    assert s.match_tokens(_prompt(1, 2)) == {"r0": 8}
+    clk.advance(11.0)
+    # stale heat is ignored (the replica's cache churned) and pruned
+    assert s.match_tokens(_prompt(1, 2)) == {}
+    s.note(_prompt(3), "r1")
+    assert s.drop_replica("r1") == 1
+    assert s.match_tokens(_prompt(3)) == {}
+
+
+def test_sketch_lru_bound():
+    s = PrefixSketch(block_tokens=1, max_entries=4)
+    for b in range(8):
+        s.note(np.array([b], np.int32), "r0")
+    assert len(s) == 4
+    assert s.match_tokens(np.array([0], np.int32)) == {}
+    assert s.match_tokens(np.array([7], np.int32)) == {"r0": 1}
+
+
+# ----------------------------------------------------------------------
+# placement: affinity, tie-breaks, exclusion, round-robin
+# ----------------------------------------------------------------------
+def test_affinity_places_on_sketch_matched_replica():
+    r0, r1 = _FakeReplica("r0"), _FakeReplica("r1")
+    router = _FakeRouter([r0, r1])
+    p = _prompt(1, 2, 3)
+    router.sketch.note(p, "r1")
+    rid = router.submit(p, max_new_tokens=4)
+    rr = router._requests[rid]
+    assert rr.state == "admitted" and rr.replica == "r1"
+    assert r1.submits and not r0.submits
+    # a successful placement re-notes the chain on the chosen replica
+    assert router.sketch.match_tokens(p)["r1"] == 12
+
+
+def test_affinity_tiebreak_prefers_shallower_queue():
+    r0, r1 = _FakeReplica("r0"), _FakeReplica("r1")
+    router = _FakeRouter([r0, r1])
+    # no sketch heat anywhere: in-flight depth decides; r0 holds one
+    rid0 = router.submit(_prompt(1), max_new_tokens=4)
+    assert router._requests[rid0].replica == "r0"    # name-ordered tie
+    rid1 = router.submit(_prompt(2), max_new_tokens=4)
+    assert router._requests[rid1].replica == "r1"    # r0 has 1 in flight
+
+
+def test_fleet_view_down_excluded_and_depth_used():
+    r0, r1 = _FakeReplica("r0"), _FakeReplica("r1")
+    fv = _FakeFleetView([("r0", "down", 0.0), ("r1", "healthy", 3.0)])
+    router = _FakeRouter([r0, r1], fleet_view=fv)
+    ladder = router.ladder(_prompt(5))
+    assert [rep.name for rep, _ in ladder] == ["r1"]
+    rid = router.submit(_prompt(5), max_new_tokens=4)
+    assert router._requests[rid].replica == "r1"
+    assert not r0.submits
+
+
+def test_draining_replica_cooldown_and_recovery():
+    clk = _FakeClock()
+    r0, r1 = _FakeReplica("r0", mode="drain"), _FakeReplica("r1")
+    router = _FakeRouter([r0, r1], clock=clk, drain_cooldown_s=5.0)
+    rid = router.submit(_prompt(1), max_new_tokens=4)
+    rr = router._requests[rid]
+    # r0 answered 503 -> next rung admitted; r0 excluded for cooldown
+    assert rr.replica == "r1"
+    assert [h["outcome"] for h in rr.hops] == ["draining", "admitted"]
+    assert [rep.name for rep, _ in router.ladder(_prompt(2))] == ["r1"]
+    clk.advance(6.0)
+    r0.mode = "admit"
+    names = [rep.name for rep, _ in router.ladder(_prompt(2))]
+    assert "r0" in names
+
+
+def test_retry_ladder_order_and_backoff_rounds():
+    r0, r1 = _FakeReplica("r0", mode="shed:queue_full"), \
+        _FakeReplica("r1", mode="shed:queue_full")
+    router = _FakeRouter([r0, r1], max_retries=2)
+    p = _prompt(1, 2)
+    router.sketch.note(p, "r1")          # r1 is the ladder's first rung
+    rid = router.submit(p, max_new_tokens=4)
+    rr = router._requests[rid]
+    assert rr.state == "shed"
+    assert rid in router.rejected
+    # 3 rounds (1 + max_retries) x 2 rungs, best-first within a round
+    assert rr.attempts == 6
+    assert [h["replica"] for h in rr.hops] == ["r1", "r0"] * 3
+    assert all(h["outcome"] == "shed:queue_full" for h in rr.hops)
+    # terminal shed: wait() returns without it, never hangs
+    assert router.wait([rid]) == {}
+
+
+def test_round_robin_rotation():
+    reps = [_FakeReplica(f"r{i}") for i in range(3)]
+    router = _FakeRouter(reps, policy="round_robin")
+    placed = []
+    for i in range(6):
+        rid = router.submit(_prompt(i), max_new_tokens=4)
+        placed.append(router._requests[rid].replica)
+    assert placed == ["r0", "r1", "r2"] * 2
+
+
+# ----------------------------------------------------------------------
+# failover
+# ----------------------------------------------------------------------
+def test_dead_replica_fails_over_admitted_requests():
+    r0, r1 = _FakeReplica("r0"), _FakeReplica("r1")
+    router = _FakeRouter([r0, r1], failover_after=2)
+    p = _prompt(1, 2)
+    router.sketch.note(p, "r0")
+    rids = [router.submit(p, max_new_tokens=4) for _ in range(3)]
+    assert all(router._requests[r].replica == "r0" for r in rids)
+    r0.mode = "dead"                      # SIGKILL, no drain
+    router.poll_once()                    # fail 1: not yet
+    assert all(router._requests[r].state == "admitted" for r in rids)
+    router.poll_once()                    # fail 2: mass failover
+    for rid in rids:
+        rr = router._requests[rid]
+        assert rr.state == "admitted" and rr.replica == "r1"
+        assert rr.failovers == 1
+    # the dead replica's sketch heat died with its cache
+    assert "r0" not in router.sketch.match_tokens(p)
+    r1.finish_all()
+    done = router.wait(rids, timeout_s=5.0)
+    assert sorted(done) == sorted(rids)   # zero admitted requests lost
+    assert all(list(t) == [1, 2, 3] for t in done.values())
+
+
+def test_submit_conn_error_skips_to_next_rung():
+    r0, r1 = _FakeReplica("r0", mode="dead"), _FakeReplica("r1")
+    router = _FakeRouter([r0, r1])
+    rid = router.submit(_prompt(1), max_new_tokens=4)
+    rr = router._requests[rid]
+    assert rr.state == "admitted" and rr.replica == "r1"
+    assert [h["outcome"] for h in rr.hops] == ["conn_error", "admitted"]
+    # the unreachable replica is suspect: excluded from the next ladder
+    assert [rep.name for rep, _ in router.ladder(_prompt(2))] == ["r1"]
+
+
+def test_async_shed_replaced_and_unknown_uid_fails_over():
+    r0, r1 = _FakeReplica("r0"), _FakeReplica("r1")
+    router = _FakeRouter([r0, r1])
+    p = _prompt(1)
+    router.sketch.note(p, "r0")
+    rid = router.submit(p, max_new_tokens=4)
+    rr = router._requests[rid]
+    uid = rr.uid
+    # deadline sweep shed it on the replica: the router re-places
+    r0.results[uid] = {"status": "shed", "reason": "deadline_expired"}
+    router.poll_once()
+    assert rr.state == "admitted"
+    assert rr.replica in ("r0", "r1")
+    # a restarted replica that lost the uid entirely: failover — but
+    # only after failover_after CONSECUTIVE unknowns (one spurious
+    # unknown must not duplicate the request)
+    cur = router._fakes[rr.replica]
+    del cur.results[rr.uid]
+    router.poll_once()
+    assert rr.state == "admitted" and rr.failovers == 0
+    router.poll_once()
+    assert rr.state == "admitted" and rr.failovers == 1
+
+
+def test_async_shed_ping_pong_bounded_by_storm_cap():
+    # a replica that admits then async-sheds every copy (deadline
+    # pressure) must not loop forever: the re-placement cap sheds the
+    # request at the router after MAX_FAILOVERS rounds
+    r0 = _FakeReplica("r0")
+    router = _FakeRouter([r0], max_retries=0)
+    rid = router.submit(_prompt(1), max_new_tokens=4)
+    rr = router._requests[rid]
+    for _ in range(Router.MAX_FAILOVERS + 2):
+        if rr.state != "admitted":
+            break
+        r0.results[rr.uid] = {"status": "shed",
+                              "reason": "deadline_expired"}
+        router.poll_once()
+    assert rr.state == "shed"
+    assert rr.shed_reason == "failover_storm"
+    assert rr.replacements == Router.MAX_FAILOVERS + 1
+    assert router.wait([rid]) == {}          # terminal, never hangs
+
+
+def test_shed_label_vocabulary_is_bounded():
+    # admission slugs pass through; free-text errors (a 400's
+    # ValueError message, a 500's repr) must NOT mint per-message
+    # registry labelsets
+    assert _shed_label(429, {"shed": "queue_full"}) == "queue_full"
+    assert _shed_label(503, {"shed": "draining"}) == "draining"
+    assert _shed_label(
+        400, {"error": "prompt(71) + max_new_tokens(8) exceeds..."}) \
+        == "bad_request"
+    assert _shed_label(500, {"error": "RuntimeError('boom')"}) \
+        == "server_error"
+    assert _shed_label(418, {}) == "http_418"
+    assert _shed_label(429, {"shed": "Weird Message!"}) == "http_429"
+
+
+# ----------------------------------------------------------------------
+# tracing: hop chaining end-to-end
+# ----------------------------------------------------------------------
+def test_traceparent_hop_chaining_and_stitch():
+    r0, r1 = _FakeReplica("r0", mode="shed:queue_full"), \
+        _FakeReplica("r1")
+    router = _FakeRouter([r0, r1], max_retries=0)
+    p = _prompt(1, 2)
+    router.sketch.note(p, "r0")
+    rid = router.submit(p, max_new_tokens=4)
+    rr = router._requests[rid]
+    assert rr.replica == "r1"
+    # every hop carried a W3C traceparent with the SAME trace id and a
+    # DISTINCT hop span id, each a child of the request's root span
+    tps = [tp for _, tp in r0.submits] + [tp for _, tp in r1.submits]
+    ctxs = [reqtrace.parse_traceparent(tp) for tp in tps]
+    assert all(c is not None for c in ctxs)
+    assert {c.trace_id for c in ctxs} == {rr.ctx.trace_id}
+    hop_ids = {c.parent_id for c in ctxs}       # the incoming span ids
+    assert len(hop_ids) == 2                    # one per hop, distinct
+    # complete the request and stitch router + a simulated replica
+    # payload (what the replica's RequestTracer retains under the
+    # propagated context) into one cross-surface trace
+    r1.finish_all()
+    router.wait([rid], timeout_s=5.0)
+    admitted_ctx = reqtrace.parse_traceparent(r1.submits[0][1])
+    replica_payload = {"traces": [{
+        "trace_id": admitted_ctx.trace_id,
+        "uid": rr.uid, "retained": "sampled", "slo_ok": True,
+        "n_out": 2, "ttft_ms": 5.0, "tpot_ms": 1.0,
+        "t_unix": 1e9, "clock_offset_s": 0.0,
+        "spans": [{"trace_id": admitted_ctx.trace_id,
+                   "span_id": admitted_ctx.span_id,
+                   "parent_id": admitted_ctx.parent_id,
+                   "name": "request", "t0_s": 0.0, "t1_s": 1.0,
+                   "attrs": {}}],
+    }]}
+    stitched = fleet.stitch_tracez({"router": router.tracez(),
+                                    "r1": replica_payload})
+    assert stitched["n_traces"] == 1
+    tr = stitched["traces"][0]
+    assert tr["trace_id"] == rr.ctx.trace_id
+    assert tr["cross_replica"] is True
+    assert set(tr["replicas"]) == {"router", "r1"}
+    names = {(s["replica"], s["name"]) for s in tr["spans"]}
+    assert ("router", "route") in names
+    assert ("router", "hop") in names
+    assert ("r1", "request") in names
+    # the replica's request span chains under the admitting hop span
+    replica_span = next(s for s in tr["spans"]
+                        if s["replica"] == "r1")
+    hop_spans = {s["span_id"] for s in tr["spans"]
+                 if s["name"] == "hop"}
+    assert replica_span["parent_id"] in hop_spans
+
+
+def test_router_trace_retained_for_shed_requests():
+    r0 = _FakeReplica("r0", mode="shed:queue_full")
+    router = _FakeRouter([r0], max_retries=0)
+    rid = router.submit(_prompt(1), max_new_tokens=4)
+    assert router.rejected[rid] == "shed:queue_full"
+    traces = router.tracez()["traces"]
+    assert len(traces) == 1 and traces[0]["uid"] == rid
+    assert any(s["name"] == "route" for s in traces[0]["spans"])
+
+
+# ----------------------------------------------------------------------
+# discovery
+# ----------------------------------------------------------------------
+def test_discovery_file_serve_ports_and_refresh(tmp_path):
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps({"replicas": [
+        {"rank": 0, "host": "127.0.0.1", "port": 9100,
+         "serve_port": 9200},
+        {"rank": 1, "host": "127.0.0.1", "port": 9101},   # exporter only
+    ]}))
+    router = Router(discovery_file=str(path))
+    assert {n: r.serve for n, r in router._reps.items()} == \
+        {"rank0": "127.0.0.1:9200"}
+    # a restarted replica on a new serve port is picked up on mtime
+    # change, and its sketch heat dropped
+    router.sketch.note(_prompt(1), "rank0")
+    path.write_text(json.dumps({"replicas": [
+        {"rank": 0, "host": "127.0.0.1", "port": 9100,
+         "serve_port": 9300}]}))
+    os.utime(path, (os.path.getmtime(path) + 2,
+                    os.path.getmtime(path) + 2))
+    router._refresh_discovery()
+    assert router._reps["rank0"].serve == "127.0.0.1:9300"
+    assert router.sketch.match_tokens(_prompt(1)) == {}
+
+
+def test_write_serve_discovery(tmp_path):
+    class _Srv:
+        host, port = "127.0.0.1", 4242
+    p = write_serve_discovery(_Srv(), rank=3, directory=str(tmp_path))
+    assert p and p.endswith("serve_rank3.json")
+    doc = json.loads(open(p).read())
+    assert doc["port"] == 4242 and doc["rank"] == 3

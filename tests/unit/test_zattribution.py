@@ -39,6 +39,18 @@ def fresh_plane(monkeypatch):
     yield plane
 
 
+@pytest.fixture(autouse=True)
+def _fresh_anomaly(monkeypatch):
+    """Swap in a fresh module anomaly engine per test (the
+    ``test_zadmission`` fixture): the induced SLO burn below genuinely
+    fires ``slo_burn`` on whatever engine is current, and an alert left
+    ACTIVE on the process singleton would alert-promote traces in
+    suites that run after this file in the same pytest process
+    (``test_zreqtrace`` was the observed victim)."""
+    monkeypatch.setattr(anomaly, "_default", anomaly.AnomalyEngine())
+    yield
+
+
 def _build_batcher(n_slots=2, max_tokens=64):
     cfg = gpt2_config("gpt2-tiny")
     model = GPT2LMHeadModel(cfg)

@@ -19,10 +19,22 @@ import deepspeed_tpu
 from deepspeed_tpu.comm import mesh as mesh_mod
 from deepspeed_tpu.inference.serving import ContinuousBatcher
 from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_config
-from deepspeed_tpu.telemetry import (exporter, fleet, flightrec, registry,
-                                     reqtrace)
+from deepspeed_tpu.telemetry import (anomaly, exporter, fleet, flightrec,
+                                     registry, reqtrace)
 
 MAX_TOKENS = 48
+
+
+@pytest.fixture(autouse=True)
+def _fresh_anomaly(monkeypatch):
+    """Fresh module anomaly engine per test (the ``test_zadmission``
+    fixture): retirement promotes ALERT-COINCIDENT traces, so an alert
+    another suite left active on the process singleton (the
+    ``test_zattribution`` induced SLO burn was the observed source)
+    would promote every trace here and break the sampling/retention
+    assertions."""
+    monkeypatch.setattr(anomaly, "_default", anomaly.AnomalyEngine())
+    yield
 
 
 @pytest.fixture(scope="module")
