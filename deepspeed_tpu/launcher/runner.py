@@ -211,6 +211,15 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch the job this many times after a failure "
                         "(workers resume via load_checkpoint)")
+    p.add_argument("--auto_resume", "--auto-resume", type=str, default=None,
+                   metavar="CKPT_DIR",
+                   help="resolve the newest VERIFIED checkpoint under this "
+                        "dir at every (re)launch and inject "
+                        "DSTPU_RESUME_DIR/DSTPU_RESUME_TAG; training "
+                        "scripts pick it up via "
+                        "checkpointing.maybe_auto_resume(engine).  With "
+                        "--max_restarts, a crashed run resumes from the "
+                        "last good checkpoint instead of step 0")
     p.add_argument("--metrics_dir", type=str, default=None,
                    help="directory for per-rank telemetry dumps: each "
                         "worker writes metrics_rank<k>.json (a registry "
@@ -291,6 +300,33 @@ _TERM_GRACE_S = 10.0    # SIGTERM → SIGKILL escalation window (lets the
                         # AsyncCheckpointManager SIGTERM-save finish)
 
 
+def _resolve_auto_resume(args) -> dict:
+    """``--auto_resume``: env to inject into workers naming the newest
+    VERIFIED checkpoint (integrity-manifest replay — a torn or corrupt
+    ``latest`` must not be handed to a fresh attempt; the worker-side
+    ``maybe_auto_resume`` still walks back if storage rots between this
+    resolve and the load).  Re-evaluated at every restart attempt, so
+    each relaunch resumes from whatever the dying attempt managed to
+    commit."""
+    if not args.auto_resume:
+        return {}
+    from ..runtime.checkpointing import resolve_newest_verified
+
+    resume_dir = os.path.abspath(args.auto_resume)
+    try:
+        tag = resolve_newest_verified(resume_dir)
+    except Exception as e:
+        logger.warning(f"auto-resume: resolve failed ({e!r}); fresh start")
+        return {}
+    if tag is None:
+        logger.info(f"auto-resume: no verified checkpoint under "
+                    f"{resume_dir}; fresh start")
+        return {"DSTPU_RESUME_DIR": resume_dir}
+    logger.info(f"auto-resume: workers will restore {tag!r} from "
+                f"{resume_dir}")
+    return {"DSTPU_RESUME_DIR": resume_dir, "DSTPU_RESUME_TAG": tag}
+
+
 def _reap(procs, grace: float = _TERM_GRACE_S):
     """terminate → wait(grace) → kill: a worker whose SIGTERM handler
     never returns (or that is truly hung — the case heartbeat detection
@@ -321,11 +357,13 @@ def _launch_local_procs(args, interrupted: Optional[list] = None) -> int:
     hb_dir = tempfile.mkdtemp(prefix="dstpu_hb_") \
         if args.heartbeat_timeout > 0 else None
     hb_files = []
+    resume_env = _resolve_auto_resume(args)
     for pid_idx in range(args.num_processes):
         env = dict(os.environ,
                    DSTPU_COORDINATOR=coord,
                    DSTPU_NUM_PROCESSES=str(args.num_processes),
-                   DSTPU_PROCESS_ID=str(pid_idx))
+                   DSTPU_PROCESS_ID=str(pid_idx),
+                   **resume_env)
         if args.metrics_dir:
             env["DSTPU_METRICS_DIR"] = args.metrics_dir
         if args.telemetry_port is not None:
@@ -525,6 +563,7 @@ def main(argv=None) -> int:
         os.environ["DSTPU_METRICS_DIR"] = args.metrics_dir
     if args.telemetry_port is not None:
         os.environ["DSTPU_TELEMETRY_PORT"] = str(args.telemetry_port)
+    os.environ.update(_resolve_auto_resume(args))
     os.execv(sys.executable, [sys.executable, args.user_script] + args.user_args)
 
 

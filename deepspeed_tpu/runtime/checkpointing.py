@@ -1,4 +1,4 @@
-"""Checkpoint save/load.
+"""Checkpoint save/load with integrity, retention, and fallback.
 
 Analog of the reference engine checkpoint suite (``engine.py:2751``
 ``save_checkpoint``, ``:2421`` ``load_checkpoint``, ``latest`` tag file
@@ -16,21 +16,92 @@ Analog of the reference engine checkpoint suite (``engine.py:2751``
 - fp32 consolidation (the ``zero_to_fp32.py`` analog, reference
   ``utils/zero_to_fp32.py:362``) = restore params with fully-replicated
   sharding → numpy tree; see :func:`get_fp32_state_dict_from_checkpoint`.
+
+Durability layer (the training half of the fault-tolerance story —
+serving got sheds/deadlines/failover in PRs 13-14):
+
+- **Integrity manifest** — every commit writes ``MANIFEST.json`` inside
+  the checkpoint dir: file list + sizes, full sha256 of small files
+  (metadata, zarray headers, test-sized shards), bounded head+tail
+  "spot" hashes of large shards, and an engine-counter snapshot.
+  :func:`verify_checkpoint` replays it; a flipped byte, truncated
+  shard, or torn (manifest-less) dir is rejected.
+- **Retention GC** — :func:`gc_checkpoints` enforces ``keep_last_n`` /
+  ``keep_every`` over ``global_step<N>`` dirs and NEVER deletes the
+  ``latest``-pointed tag, an in-flight async checkpoint (the manager
+  passes it via ``protect``), or a tag it didn't name (guard
+  snapshots, user tags).  Torn dirs from crashed saves are garbage and
+  are collected.
+- **Last-good fallback** — ``load_checkpoint(fallback=True)`` walks
+  back (newest → oldest) to the newest checkpoint that verifies when
+  the latest is torn or corrupt, logging every tag it skipped and why.
+- **Deterministic resume** — the engine metadata captures the engine
+  RNG key and the dataloader iteration state (epoch, batch index,
+  shuffle seed), so an interrupted-at-step-N run resumed from the
+  checkpoint replays the SAME rng folds and the SAME remaining batch
+  sequence — bit-exact vs the uninterrupted run (proven by
+  ``tests/unit/test_zdurability.py``).
+- **Auto-resume** — the launcher's ``--auto_resume DIR`` resolves the
+  newest VERIFIED checkpoint at (re)launch and injects
+  ``DSTPU_RESUME_DIR``/``DSTPU_RESUME_TAG``; training scripts call
+  :func:`maybe_auto_resume` after ``init_params`` and the restart loop
+  turns crashes into resumes.
+
+Chaos sites (``testing/chaos.py``): ``ckpt_save_failure`` aborts the
+commit mid-write (torn dir the next save/GC must tolerate);
+``ckpt_corrupt_shard`` bit-flips a committed file after publish (the
+fallback walk must recover).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any, Optional
+import re
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from ..telemetry import registry as telemetry_registry
+from ..telemetry import trace
+from ..testing import chaos as chaos_mod
 from ..utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
 ENGINE_STATE_FILE = "engine_state.json"
 MODULE_DIR = "module"
+MANIFEST_FILE = "MANIFEST.json"
+
+# launcher --auto_resume injects these; maybe_auto_resume consumes them
+RESUME_DIR_ENV = "DSTPU_RESUME_DIR"
+RESUME_TAG_ENV = "DSTPU_RESUME_TAG"
+
+# files at or under this size get a FULL sha256 in the manifest; larger
+# shards get a bounded head+tail spot hash (64 KiB each end + size).
+# Production-scale shards are GBs — full hashes there would make every
+# commit re-read the checkpoint.
+_FULL_HASH_MAX_ENV = "DSTPU_CKPT_HASH_FULL_MAX_BYTES"
+_FULL_HASH_MAX_DEFAULT = 8 << 20
+_SPOT_BYTES = 64 << 10
+
+_TAG_RE = re.compile(r"^global_step(\d+)$")
+
+__all__ = [
+    "save_checkpoint", "load_checkpoint", "AsyncCheckpointManager",
+    "write_manifest", "verify_checkpoint", "CheckpointVerifyError",
+    "gc_checkpoints", "resolve_newest_verified", "maybe_auto_resume",
+    "get_fp32_state_dict_from_checkpoint", "LATEST_FILE",
+    "ENGINE_STATE_FILE", "MODULE_DIR", "MANIFEST_FILE",
+    "RESUME_DIR_ENV", "RESUME_TAG_ENV",
+]
+
+
+class CheckpointVerifyError(RuntimeError):
+    """The resolved checkpoint failed integrity verification (and no
+    fallback was allowed / no earlier checkpoint verified)."""
 
 
 def _checkpointer():
@@ -39,8 +110,339 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+# ----------------------------------------------------------------------
+# telemetry (counters/histograms + the /statusz `checkpoint` section)
+# ----------------------------------------------------------------------
+_metric_handles: Dict[str, Any] = {}
+_STATUS: Dict[str, Any] = {}
+_status_registered = False
+
+
+def _m(name: str):
+    if not _metric_handles:
+        _metric_handles.update(
+            saves=telemetry_registry.counter(
+                "checkpoint_saves_total", "checkpoint commits published"),
+            loads=telemetry_registry.counter(
+                "checkpoint_loads_total", "checkpoint restores completed"),
+            verify_failures=telemetry_registry.counter(
+                "checkpoint_verify_failures_total",
+                "integrity verifications that found problems"),
+            gc_deleted=telemetry_registry.counter(
+                "checkpoint_gc_deleted_total",
+                "checkpoint dirs removed by retention GC"),
+            save_ms=telemetry_registry.histogram(
+                "checkpoint_save_ms",
+                "blocking wall ms per checkpoint commit",
+                buckets=telemetry_registry.MS_BUCKETS),
+            bytes=telemetry_registry.histogram(
+                "checkpoint_bytes", "total bytes per committed checkpoint",
+                buckets=telemetry_registry.BYTES_BUCKETS),
+        )
+    return _metric_handles[name]
+
+
+def _ensure_status_registered() -> None:
+    global _status_registered
+    if _status_registered:
+        return
+    from ..telemetry import exporter as telemetry_exporter
+
+    telemetry_exporter.register_status_provider(
+        "checkpoint", lambda: dict(_STATUS) if _STATUS else None)
+    _status_registered = True
+
+
+def _note_status(**kw) -> None:
+    _ensure_status_registered()
+    _STATUS.update(kw)
+
+
+# ----------------------------------------------------------------------
+# integrity manifest
+# ----------------------------------------------------------------------
+def _atomic_write_text(path: str, text: str) -> None:
+    """tmp-file + ``os.replace``: a crash mid-``write()`` leaves the tmp
+    file, never a torn published file — the desync race
+    ``load_checkpoint``'s cross-process tag validation exists to catch
+    must not be manufacturable by the writer itself."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _full_hash_max() -> int:
+    try:
+        return int(os.environ.get(_FULL_HASH_MAX_ENV,
+                                  _FULL_HASH_MAX_DEFAULT))
+    except ValueError:
+        return _FULL_HASH_MAX_DEFAULT
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _spot_hash(path: str, size: int) -> str:
+    """Bounded content check of a large shard: sha256 over (size, first
+    64 KiB, last 64 KiB).  Catches truncation, header/footer corruption
+    and wrong-file swaps at O(128 KiB) per shard; mid-file bit rot in
+    multi-GB shards is traded away for commit cost (small files get the
+    full hash)."""
+    h = hashlib.sha256()
+    h.update(str(size).encode())
+    with open(path, "rb") as fh:
+        h.update(fh.read(_SPOT_BYTES))
+        if size > _SPOT_BYTES:
+            fh.seek(max(_SPOT_BYTES, size - _SPOT_BYTES))
+            h.update(fh.read(_SPOT_BYTES))
+    return h.hexdigest()
+
+
+def _walk_files(ckpt_dir: str) -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(ckpt_dir):
+        for fn in files:
+            rel = os.path.relpath(os.path.join(root, fn), ckpt_dir)
+            if rel == MANIFEST_FILE or ".tmp." in fn:
+                continue
+            out.append(rel)
+    out.sort()
+    return out
+
+
+def write_manifest(ckpt_dir: str,
+                   engine_counters: Optional[dict] = None) -> dict:
+    """Write ``MANIFEST.json`` for every file currently under
+    ``ckpt_dir`` (excluding the manifest itself); returns the manifest
+    dict.  Called at commit, AFTER the state shards and
+    ``engine_state.json`` exist, BEFORE the ``latest`` tag is published."""
+    full_max = _full_hash_max()
+    files = []
+    total = 0
+    for rel in _walk_files(ckpt_dir):
+        path = os.path.join(ckpt_dir, rel)
+        size = os.path.getsize(path)
+        total += size
+        entry: Dict[str, Any] = {"path": rel, "bytes": size}
+        if size <= full_max:
+            entry["sha256"] = _sha256_file(path)
+        else:
+            entry["spot_sha256"] = _spot_hash(path, size)
+        files.append(entry)
+    manifest = {
+        "manifest_version": 1,
+        "created_unix": time.time(),
+        "tag": os.path.basename(os.path.normpath(ckpt_dir)),
+        "total_bytes": total,
+        "engine": dict(engine_counters or {}),
+        "files": files,
+    }
+    _atomic_write_text(os.path.join(ckpt_dir, MANIFEST_FILE),
+                       json.dumps(manifest, indent=1))
+    return manifest
+
+
+def _is_legacy_committed(ckpt_dir: str) -> bool:
+    """Pre-durability checkpoint: published (``engine_state.json``
+    exists — the commit marker of versions before the manifest) but
+    carries no ``MANIFEST.json``.  Distinct from torn debris, which
+    died BEFORE the metadata write and has neither."""
+    return (not os.path.isfile(os.path.join(ckpt_dir, MANIFEST_FILE))
+            and os.path.isfile(os.path.join(ckpt_dir, ENGINE_STATE_FILE))
+            and os.path.isdir(os.path.join(ckpt_dir, MODULE_DIR)))
+
+
+def verify_checkpoint(ckpt_dir: str) -> List[str]:
+    """Replay the manifest against the directory; returns the list of
+    problems (empty = the checkpoint verifies).  A missing manifest —
+    the signature of a torn, crashed-mid-commit dir — is itself a
+    problem, EXCEPT for pre-durability checkpoints (committed
+    ``engine_state.json``, no manifest): those pass with a warning —
+    an upgrade must not strand every existing save dir.  Failures land
+    in ``checkpoint_verify_failures_total``."""
+    problems: List[str] = []
+    mpath = os.path.join(ckpt_dir, MANIFEST_FILE)
+    if not os.path.isdir(ckpt_dir):
+        problems.append("checkpoint dir missing")
+    elif not os.path.isfile(mpath):
+        if _is_legacy_committed(ckpt_dir):
+            logger.warning(
+                f"checkpoint {ckpt_dir} predates integrity manifests; "
+                "accepting without verification")
+            return []
+        problems.append(f"no {MANIFEST_FILE} (torn/uncommitted dir)")
+    else:
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as e:
+            manifest = None
+            problems.append(f"unreadable {MANIFEST_FILE}: {e!r}")
+        if manifest is not None:
+            for entry in manifest.get("files", ()):
+                path = os.path.join(ckpt_dir, entry["path"])
+                if not os.path.isfile(path):
+                    problems.append(f"missing file {entry['path']}")
+                    continue
+                size = os.path.getsize(path)
+                if size != entry["bytes"]:
+                    problems.append(
+                        f"size mismatch {entry['path']}: "
+                        f"{size} != {entry['bytes']}")
+                    continue
+                if "sha256" in entry:
+                    if _sha256_file(path) != entry["sha256"]:
+                        problems.append(f"sha256 mismatch {entry['path']}")
+                elif "spot_sha256" in entry:
+                    if _spot_hash(path, size) != entry["spot_sha256"]:
+                        problems.append(
+                            f"spot-hash mismatch {entry['path']}")
+    if problems:
+        _m("verify_failures").inc()
+        _note_status(last_verify_failure={
+            "dir": ckpt_dir, "problems": problems[:8],
+            "t": time.time()})
+    return problems
+
+
+# ----------------------------------------------------------------------
+# tag resolution, retention GC, fallback
+# ----------------------------------------------------------------------
+def _read_latest_tag(load_dir: str) -> Optional[str]:
+    latest_path = os.path.join(load_dir, LATEST_FILE)
+    try:
+        with open(latest_path) as fh:
+            tag = fh.read().strip()
+        return tag or None
+    except OSError:
+        return None
+
+
+def _candidate_tags(save_dir: str) -> List[Tuple[int, float, str]]:
+    """Checkpoint-dir candidates as ``(step, mtime, tag)`` sorted newest
+    first.  Tags that don't parse as ``global_step<N>`` carry step = -1:
+    GC skips them, and the fallback/resolve walks only restore them when
+    the ``latest`` tag or an explicit ``tag=`` names them — a guard
+    forensic snapshot of DIVERGING state verifies clean and must never
+    be auto-chosen."""
+    out: List[Tuple[int, float, str]] = []
+    try:
+        names = os.listdir(save_dir)
+    except OSError:
+        return out
+    for name in names:
+        path = os.path.join(save_dir, name)
+        if not os.path.isdir(path):
+            continue
+        m = _TAG_RE.match(name)
+        step = int(m.group(1)) if m else -1
+        try:
+            mt = os.path.getmtime(path)
+        except OSError:
+            mt = 0.0
+        out.append((step, mt, name))
+    out.sort(reverse=True)
+    return out
+
+
+def gc_checkpoints(save_dir: str, keep_last_n: int = 0,
+                   keep_every: int = 0,
+                   protect: Sequence[str] = ()) -> List[str]:
+    """Retention GC over ``global_step<N>`` checkpoint dirs.
+
+    Keeps the newest ``keep_last_n`` COMMITTED (manifest-bearing)
+    checkpoints plus every step divisible by ``keep_every`` (archival
+    points); deletes the rest — including torn dirs from crashed saves.
+    Never touches: the ``latest``-pointed tag, tags in ``protect`` (the
+    async manager passes its in-flight tag), or tags that don't parse
+    as ``global_step<N>`` (guard snapshots, user tags — never delete
+    what this policy didn't name).  ``keep_last_n <= 0`` disables GC.
+    Returns the deleted tags."""
+    if keep_last_n <= 0:
+        return []
+    protected = set(protect)
+    latest = _read_latest_tag(save_dir)
+    if latest:
+        protected.add(latest)
+    committed: List[Tuple[int, str]] = []
+    candidates: List[Tuple[int, str]] = []
+    for step, _mt, tag in _candidate_tags(save_dir):
+        if step < 0:
+            continue                       # not ours to manage
+        candidates.append((step, tag))
+        d = os.path.join(save_dir, tag)
+        # manifest-bearing OR pre-durability published dirs count as
+        # committed; only never-published debris is torn
+        if os.path.isfile(os.path.join(d, MANIFEST_FILE)) \
+                or _is_legacy_committed(d):
+            committed.append((step, tag))
+    keep = {tag for _s, tag in committed[:keep_last_n]}
+    if keep_every > 0:
+        keep |= {tag for step, tag in committed
+                 if step % keep_every == 0}
+    deleted: List[str] = []
+    for _step, tag in candidates:
+        if tag in keep or tag in protected:
+            continue
+        try:
+            shutil.rmtree(os.path.join(save_dir, tag))
+        except OSError as e:
+            logger.warning(f"checkpoint GC could not delete {tag}: {e!r}")
+            continue
+        deleted.append(tag)
+        _m("gc_deleted").inc()
+    if deleted:
+        log_dist(f"checkpoint GC deleted {deleted} "
+                 f"(keep_last_n={keep_last_n} keep_every={keep_every})",
+                 ranks=[0])
+    _note_status(retention={
+        "keep_last_n": keep_last_n, "keep_every": keep_every,
+        "kept": sorted(keep), "last_gc_deleted": deleted})
+    return deleted
+
+
+def point_latest(save_dir: str, tag: str) -> None:
+    """Force the ``latest`` tag (atomic).  The TrainGuard uses this
+    after a rollback: it is authoritative that every checkpoint newer
+    than the restored one sits on the diverged trajectory, and the
+    monotonic no-rewind rule in ``_publish_meta`` would otherwise keep
+    ``latest`` on the bad state until the replay overtakes it."""
+    if jax.process_index() != 0:
+        return
+    _atomic_write_text(os.path.join(save_dir, LATEST_FILE), tag)
+
+
+def resolve_newest_verified(save_dir: str) -> Optional[str]:
+    """Tag of the newest checkpoint under ``save_dir`` that passes
+    :func:`verify_checkpoint` (the ``latest``-pointed tag is tried
+    first); None when nothing verifies.  Pure host-side file walk — the
+    launcher calls this before any worker exists."""
+    tried = set()
+    latest = _read_latest_tag(save_dir)
+    order: List[str] = [latest] if latest else []
+    order += [tag for s, _m_, tag in _candidate_tags(save_dir) if s >= 0]
+    for tag in order:
+        if tag in tried:
+            continue
+        tried.add(tag)
+        if not verify_checkpoint(os.path.join(save_dir, tag)):
+            return tag
+    return None
+
+
+# ----------------------------------------------------------------------
+# save
+# ----------------------------------------------------------------------
 def _build_meta(engine, client_state: Optional[dict]) -> dict:
-    return {
+    meta = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
         "micro_steps": engine.micro_steps,
@@ -48,23 +450,109 @@ def _build_meta(engine, client_state: Optional[dict]) -> dict:
         "zero_stage": engine.zero_stage,
         "mesh": dict(engine.mesh.shape),
         "client_state": client_state or {},
-        "dstpu_version": 1,
+        "dstpu_version": 2,
     }
+    # deterministic-resume state: the engine rng key + the dataloader
+    # iteration position.  Captured HERE (save time), not at commit —
+    # by async-commit time the engine has moved on.
+    resume: Dict[str, Any] = {}
+    rng_state = getattr(engine, "_rng_state", None)
+    if callable(rng_state):
+        resume["rng"] = rng_state()
+    dl_state = getattr(engine, "_dataloader_state", None)
+    if callable(dl_state):
+        dl = dl_state()
+        if dl:
+            resume["dataloader"] = dl
+    if resume:
+        meta["resume"] = resume
+    return meta
 
 
-def _publish_meta(meta: dict, save_dir: str, ckpt_dir: str, tag: str) -> None:
-    if jax.process_index() == 0:
-        with open(os.path.join(ckpt_dir, ENGINE_STATE_FILE), "w") as fh:
-            json.dump(meta, fh, indent=2)
-        # tag-file written LAST so a crash mid-save never points at a torn
-        # checkpoint (reference writes `latest` after all ranks finish)
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
-            fh.write(tag)
+def _engine_counters(meta: dict) -> dict:
+    return {k: meta.get(k) for k in (
+        "global_steps", "global_samples", "micro_steps", "skipped_steps")}
+
+
+def _publish_meta(meta: dict, save_dir: str, ckpt_dir: str, tag: str,
+                  update_latest: bool = True) -> Optional[dict]:
+    """Commit: engine metadata (atomic) → MANIFEST (atomic) → ``latest``
+    tag (atomic, LAST — a crash mid-save never points at a torn
+    checkpoint; reference writes ``latest`` after all ranks finish).
+    ``update_latest=False`` commits WITHOUT repointing ``latest`` — the
+    TrainGuard's forensic snapshots of diverging state must never
+    become what a restart resumes from."""
+    if jax.process_index() != 0:
+        return None
+    if chaos_mod.maybe_fire("ckpt_save_failure") is not None:
+        raise chaos_mod.ChaosFault(
+            "injected checkpoint commit failure (chaos site "
+            "ckpt_save_failure): torn dir left behind")
+    _atomic_write_text(os.path.join(ckpt_dir, ENGINE_STATE_FILE),
+                       json.dumps(meta, indent=2))
+    manifest = write_manifest(ckpt_dir,
+                              engine_counters=_engine_counters(meta))
+    if update_latest:
+        # never repoint BACKWARD: a sync save can publish step N+1
+        # while an older async commit is still pending — its eventual
+        # publish must not rewind `latest` past the newer checkpoint
+        cur = _read_latest_tag(save_dir)
+        cur_m = _TAG_RE.match(cur) if cur else None
+        new_m = _TAG_RE.match(tag)
+        if cur_m and new_m and int(cur_m.group(1)) > int(new_m.group(1)):
+            logger.warning(
+                f"not repointing latest ({cur!r}) back to older {tag!r}")
+        else:
+            _atomic_write_text(os.path.join(save_dir, LATEST_FILE), tag)
+    _m("saves").inc()
+    _m("bytes").observe(manifest["total_bytes"])
+    status = dict(last_tag=tag, last_dir=ckpt_dir,
+                  last_save_unix=time.time(),
+                  last_bytes=manifest["total_bytes"])
+    if not update_latest:
+        status["last_unpublished_tag"] = status.pop("last_tag")
+    _note_status(**status)
+    return manifest
+
+
+def _maybe_chaos_corrupt(ckpt_dir: str) -> None:
+    """``ckpt_corrupt_shard`` site: after a successful commit, flip one
+    bit of the LARGEST committed file (deterministic target) — silent
+    storage corruption the verify/fallback path must catch.  Rank 0
+    only (gated BEFORE the invocation counter): two ranks XOR-flipping
+    the same byte of a shared file would cancel each other out."""
+    if jax.process_index() != 0:
+        return
+    if chaos_mod.maybe_fire("ckpt_corrupt_shard") is None:
+        return
+    best: Optional[Tuple[int, str]] = None
+    for rel in _walk_files(ckpt_dir):
+        path = os.path.join(ckpt_dir, rel)
+        size = os.path.getsize(path)
+        if size and (best is None or size > best[0]):
+            best = (size, path)
+    if best is None:
+        logger.warning("chaos: ckpt_corrupt_shard fired but no file to "
+                       f"corrupt under {ckpt_dir}")
+        return
+    size, path = best
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        byte = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([byte[0] ^ 0x80]))
+    logger.warning(f"chaos: flipped one bit of {path} "
+                   "(chaos site ckpt_corrupt_shard)")
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                    client_state: Optional[dict] = None) -> str:
-    """Write a sharded checkpoint under ``save_dir/tag`` + ``latest`` tag."""
+                    client_state: Optional[dict] = None,
+                    keep_last_n: int = 0, keep_every: int = 0,
+                    update_latest: bool = True) -> str:
+    """Write a sharded checkpoint under ``save_dir/tag`` + manifest +
+    ``latest`` tag; with ``keep_last_n`` set, run retention GC after
+    the commit.  ``update_latest=False`` keeps ``latest`` where it was
+    (forensic/side snapshots)."""
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     ckpt_dir = os.path.abspath(os.path.join(save_dir, tag))
@@ -72,14 +560,31 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     from ..utils.heartbeat import beat
 
-    ckptr = _checkpointer()
-    state_path = os.path.join(ckpt_dir, MODULE_DIR)
-    beat(min_interval_s=0.0)   # a long synchronous save must not look like
-    ckptr.save(state_path, engine.state, force=True)   # a hung worker
-    ckptr.wait_until_finished()
-    beat(min_interval_s=0.0)
-    _publish_meta(_build_meta(engine, client_state), save_dir, ckpt_dir, tag)
+    t0 = time.perf_counter()
+    # attribution: direct module-level saves (scripts, the guard) must
+    # bill `checkpoint` goodput too, not only engine.save_checkpoint's
+    # span — nesting is fine, attribution is exclusive
+    with trace.span("train/checkpoint", tag=tag):
+        ckptr = _checkpointer()
+        state_path = os.path.join(ckpt_dir, MODULE_DIR)
+        beat(min_interval_s=0.0)   # a long synchronous save must not look
+        ckptr.save(state_path, engine.state, force=True)   # like a hang
+        ckptr.wait_until_finished()
+        beat(min_interval_s=0.0)
+        _publish_meta(_build_meta(engine, client_state), save_dir,
+                      ckpt_dir, tag, update_latest=update_latest)
+    _m("save_ms").observe((time.perf_counter() - t0) * 1e3)
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+    _maybe_chaos_corrupt(ckpt_dir)
+    if keep_last_n > 0 and jax.process_index() == 0:
+        protect = {tag}
+        # an AsyncCheckpointManager's in-flight save is manifest-less
+        # mid-write: GC triggered by a SYNC save must not collect it
+        mgr = getattr(engine, "_ckpt_manager", None)
+        if mgr is not None and mgr._pending is not None:
+            protect.add(mgr._pending[1])
+        gc_checkpoints(save_dir, keep_last_n=keep_last_n,
+                       keep_every=keep_every, protect=protect)
     return ckpt_dir
 
 
@@ -90,50 +595,101 @@ class AsyncCheckpointManager:
 
     - ``save()`` hands the device state to orbax's AsyncCheckpointer: the
       host copy + write happen on a background thread while training
-      continues.  The ``latest`` tag and engine metadata are written only
-      when the async commit finishes (on the next ``save()``/``step()``/
-      ``wait()``), so a crash mid-write never points at a torn checkpoint.
-    - ``install_sigterm=True`` registers a SIGTERM handler (the TPU/GKE
-      preemption signal): the handler only sets ``preempted``; the next
-      ``step()`` call performs a final SYNCHRONOUS save and returns its
-      path, letting the training loop exit cleanly within the grace
-      period.
+      continues.  The ``latest`` tag, manifest and engine metadata are
+      written only when the async commit finishes (on the next
+      ``save()``/``step()``/``wait()``), so a crash mid-write never
+      points at a torn checkpoint.
+    - ``install_sigterm=True`` arms the SIGTERM (TPU/GKE preemption)
+      path WITHOUT dropping anyone else's handler: when the flight
+      recorder owns the signal, the manager registers a
+      ``flightrec.add_sigterm_hook`` that performs the final SYNCHRONOUS
+      save inside the hook (the recorder re-delivers the signal after
+      its hooks + dump — there is no "next step()" to save at);
+      otherwise it installs its own handler that sets ``preempted`` and
+      CHAINS to the previous callable handler.  The next ``step()``
+      call then performs a final synchronous save and returns its path,
+      letting the training loop exit cleanly within the grace period.
+    - ``keep_last_n``/``keep_every`` run retention GC after every
+      commit; the in-flight tag is protected until its commit publishes.
     """
 
     def __init__(self, engine, save_dir: str, interval_steps: int = 0,
-                 install_sigterm: bool = True):
+                 install_sigterm: bool = True,
+                 keep_last_n: int = 0, keep_every: int = 0):
         import orbax.checkpoint as ocp
 
         self.engine = engine
         self.save_dir = save_dir
         self.interval_steps = interval_steps
+        self.keep_last_n = keep_last_n
+        self.keep_every = keep_every
         self.preempted = False
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         self._pending: Optional[tuple] = None   # (ckpt_dir, tag, meta-snapshot)
+        # let the TrainGuard find the live manager: a rollback must
+        # discard a pending save of the diverged state before it commits
+        engine._ckpt_manager = self
         self._prev_handler = None
+        self._hook_remove = None
         if install_sigterm:
             import signal
 
-            def _on_sigterm(signum, frame):
-                self.preempted = True
-                logger.warning("SIGTERM received: checkpoint at next step()")
+            from ..telemetry import flightrec
 
-            self._prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            if flightrec.sigterm_managed():
+                # the recorder's handler runs hooks → dump → re-delivers
+                # the signal (process dies): save NOW, inside the hook
+                def _hook():
+                    self.preempted = True
+                    logger.warning(
+                        "SIGTERM: final synchronous checkpoint from the "
+                        "flight-recorder hook (signal is re-delivered "
+                        "after the dump)")
+                    try:
+                        self.save(sync=True)
+                    except Exception as e:   # the dump must still happen
+                        logger.warning(
+                            f"SIGTERM checkpoint failed: {e!r}")
+
+                self._hook_remove = flightrec.add_sigterm_hook(_hook)
+            else:
+                def _on_sigterm(signum, frame):
+                    self.preempted = True
+                    logger.warning(
+                        "SIGTERM received: checkpoint at next step()")
+                    prev = self._prev_handler
+                    if callable(prev):
+                        # chain, don't drop: whoever installed before us
+                        # (flight recorder installed later-armed, custom
+                        # drain hooks) keeps firing
+                        prev(signum, frame)
+
+                self._prev_handler = signal.signal(signal.SIGTERM,
+                                                   _on_sigterm)
 
     # ------------------------------------------------------------------
     def _finalize(self):
-        """Block on any in-flight save, then publish its meta + tag."""
+        """Block on any in-flight save, then publish its meta + manifest
+        + tag and run retention GC."""
         if self._pending is None:
             return
         from ..utils.heartbeat import beat
 
-        beat(min_interval_s=0.0)
-        self._ckptr.wait_until_finished()
-        beat(min_interval_s=0.0)
-        ckpt_dir, tag, meta = self._pending
-        self._pending = None
-        _publish_meta(meta, self.save_dir, ckpt_dir, tag)
+        t0 = time.perf_counter()
+        with trace.span("train/checkpoint", phase="async-commit"):
+            beat(min_interval_s=0.0)
+            self._ckptr.wait_until_finished()
+            beat(min_interval_s=0.0)
+            ckpt_dir, tag, meta = self._pending
+            self._pending = None
+            _note_status(pending_async=None)
+            _publish_meta(meta, self.save_dir, ckpt_dir, tag)
+        _m("save_ms").observe((time.perf_counter() - t0) * 1e3)
         log_dist(f"committed async checkpoint {ckpt_dir}", ranks=[0])
+        _maybe_chaos_corrupt(ckpt_dir)
+        if self.keep_last_n > 0 and jax.process_index() == 0:
+            gc_checkpoints(self.save_dir, keep_last_n=self.keep_last_n,
+                           keep_every=self.keep_every, protect=(tag,))
 
     def save(self, tag: Optional[str] = None, sync: bool = False,
              client_state: Optional[dict] = None) -> str:
@@ -151,6 +707,7 @@ class AsyncCheckpointManager:
                          force=True)
         # snapshot the counters NOW — by commit time the engine has moved on
         self._pending = (ckpt_dir, tag, _build_meta(self.engine, client_state))
+        _note_status(pending_async=tag)
         if sync:
             self._finalize()
         return ckpt_dir
@@ -170,9 +727,38 @@ class AsyncCheckpointManager:
     def wait(self):
         self._finalize()
 
+    def discard_pending(self) -> Optional[str]:
+        """Drop the in-flight save WITHOUT publishing it (TrainGuard
+        rollback: the scheduled state is the diverged state the guard
+        is rolling back from — committing it would repoint ``latest``
+        at exactly what was just undone).  The underlying write cannot
+        be cancelled, so this waits it out, then removes the
+        never-published dir — leaving it would make every later
+        resolve/fallback walk re-hash and re-fail it forever when GC
+        is off (``keep_last_n=0``).  Returns the dropped tag."""
+        if self._pending is None:
+            return None
+        self._ckptr.wait_until_finished()
+        ckpt_dir, tag, _meta = self._pending
+        self._pending = None
+        _note_status(pending_async=None)
+        try:
+            shutil.rmtree(ckpt_dir)
+        except OSError as e:          # best-effort; GC can still catch it
+            logger.warning(
+                f"could not remove discarded checkpoint {ckpt_dir}: {e!r}")
+        logger.warning(f"discarded pending checkpoint {ckpt_dir} "
+                       "(never published)")
+        return tag
+
     def close(self):
         self._finalize()
         self._ckptr.close()
+        if getattr(self.engine, "_ckpt_manager", None) is self:
+            self.engine._ckpt_manager = None
+        if self._hook_remove is not None:
+            self._hook_remove()
+            self._hook_remove = None
         if self._prev_handler is not None:
             import signal
 
@@ -180,36 +766,96 @@ class AsyncCheckpointManager:
             self._prev_handler = None
 
 
-def _resolve_tag(load_dir: str, tag: Optional[str]) -> str:
-    if tag is not None:
-        return tag
-    latest_path = os.path.join(load_dir, LATEST_FILE)
-    if not os.path.isfile(latest_path):
-        raise FileNotFoundError(
-            f"no tag given and no '{LATEST_FILE}' file in {load_dir} "
-            "(reference engine.py:2460 behavior)")
-    with open(latest_path) as fh:
-        return fh.read().strip()
+# ----------------------------------------------------------------------
+# load
+# ----------------------------------------------------------------------
+def _resolve_verified(load_dir: str, tag: Optional[str], fallback: bool,
+                      verify: bool) -> Tuple[str, List[Tuple[str, list]]]:
+    """Resolve the tag to restore: the explicit/``latest`` tag when it
+    verifies, else (with ``fallback``) the newest checkpoint that does.
+    Returns ``(tag, skipped)`` where ``skipped`` is ``[(tag, problems)]``
+    for every candidate rejected on the way."""
+    explicit = tag is not None
+    if tag is None:
+        tag = _read_latest_tag(load_dir)
+        if tag is None and not fallback:
+            raise FileNotFoundError(
+                f"no tag given and no '{LATEST_FILE}' file in {load_dir} "
+                "(reference engine.py:2460 behavior)")
+    skipped: List[Tuple[str, list]] = []
+    if not verify:
+        if tag is None:
+            raise FileNotFoundError(
+                f"no '{LATEST_FILE}' file in {load_dir}")
+        return tag, skipped
+    order: List[str] = [tag] if tag else []
+    if fallback:
+        # the walk goes BACK: with an explicit pinned tag, only steps
+        # strictly older qualify — restoring a NEWER checkpoint would
+        # resume forward past the point the caller rewound to
+        cap = None
+        if explicit and tag:
+            m = _TAG_RE.match(tag)
+            cap = int(m.group(1)) if m else None
+        order += [t for s, _m_, t in _candidate_tags(load_dir)
+                  if 0 <= s and (cap is None or s < cap)]
+    tried = set()
+    for cand in order:
+        if cand in tried:
+            continue
+        tried.add(cand)
+        if not fallback and not os.path.isdir(os.path.join(load_dir, cand)):
+            # a plainly absent dir keeps the pre-durability contract:
+            # FileNotFoundError under strict, (None, {}) otherwise —
+            # callers distinguish "never saved" from "saved but corrupt"
+            return cand, skipped
+        problems = verify_checkpoint(os.path.join(load_dir, cand))
+        if not problems:
+            if skipped:
+                logger.warning(
+                    f"checkpoint fallback: restoring {cand!r}; skipped "
+                    + "; ".join(f"{t!r} ({p[0]})" for t, p in skipped))
+            return cand, skipped
+        skipped.append((cand, problems))
+        logger.warning(
+            f"checkpoint {cand!r} failed verification: {problems[:4]}"
+            + (" — walking back to the previous verified checkpoint"
+               if fallback else ""))
+        if not fallback:
+            raise CheckpointVerifyError(
+                f"checkpoint {os.path.join(load_dir, cand)} failed "
+                f"verification: {problems[:8]} (pass fallback=True to "
+                "walk back to the last verified checkpoint)")
+    raise CheckpointVerifyError(
+        f"no verified checkpoint under {load_dir}"
+        + (f" (explicit tag {tag!r})" if explicit else "")
+        + f"; rejected {[t for t, _ in skipped]}")
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
-                    strict: bool = True):
+                    strict: bool = True, fallback: bool = False,
+                    verify: bool = True):
     """Restore into the engine's CURRENT shardings (elastic by construction).
 
-    Returns ``(ckpt_dir, client_state)`` like the reference ``load_checkpoint``.
+    ``verify=True`` (default) replays the integrity manifest before
+    touching the state; ``fallback=True`` walks back to the newest
+    checkpoint that verifies when the resolved one is torn/corrupt
+    (logging what it skipped).  Returns ``(ckpt_dir, client_state)``
+    like the reference ``load_checkpoint``.
     """
     # every process must resolve the SAME tag (reference
     # `_checkpoint_tag_validation` engine.py:2733 — a half-written
-    # `latest` on shared storage could desynchronize hosts).  The resolve
-    # is fenced so a process that FAILS to resolve still reaches the
-    # collective (otherwise the healthy hosts would hang in allgather —
-    # the exact propagation race this check exists for).
+    # `latest` on shared storage could desynchronize hosts, and the
+    # fallback walk must not diverge).  The resolve is fenced so a
+    # process that FAILS to resolve still reaches the collective
+    # (otherwise the healthy hosts would hang in allgather — the exact
+    # propagation race this check exists for).
     from .. import comm
 
     resolve_err: Optional[Exception] = None
     try:
-        tag = _resolve_tag(load_dir, tag)
-    except (FileNotFoundError, OSError) as e:
+        tag, _skipped = _resolve_verified(load_dir, tag, fallback, verify)
+    except (FileNotFoundError, OSError, CheckpointVerifyError) as e:
         tag, resolve_err = None, e
     comm.assert_same_across_processes(
         ("ok", tag) if resolve_err is None else ("missing", None),
@@ -231,8 +877,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         lambda x, sh: jax.ShapeDtypeStruct(
             x.shape, x.dtype, sharding=getattr(x, "sharding", None) or sh),
         engine.state, engine._state_shardings)
-    ckptr = _checkpointer()
-    engine._state = ckptr.restore(state_path, abstract)
+    with trace.span("train/checkpoint", phase="restore", tag=tag):
+        ckptr = _checkpointer()
+        engine._state = ckptr.restore(state_path, abstract)
 
     meta_path = os.path.join(ckpt_dir, ENGINE_STATE_FILE)
     client_state = {}
@@ -244,8 +891,46 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.micro_steps = meta.get("micro_steps", 0)
         engine.skipped_steps = meta.get("skipped_steps", 0)
         client_state = meta.get("client_state", {})
+        resume = meta.get("resume") or {}
+        if resume.get("rng") and hasattr(engine, "_set_rng_state"):
+            engine._set_rng_state(resume["rng"])
+        if resume.get("dataloader") and \
+                hasattr(engine, "_set_dataloader_state"):
+            engine._set_dataloader_state(resume["dataloader"])
+    _m("loads").inc()
+    _note_status(last_load_tag=tag, last_load_unix=time.time())
     log_dist(f"loaded checkpoint {ckpt_dir} at step {engine.global_steps}", ranks=[0])
     return ckpt_dir, client_state
+
+
+def maybe_auto_resume(engine, load_dir: Optional[str] = None):
+    """Resume from the launcher's ``--auto_resume`` injection (or an
+    explicit ``load_dir``): restores the newest VERIFIED checkpoint with
+    the fallback walk armed.  Returns ``(ckpt_dir, client_state)`` or
+    None when there is nothing to resume from — a fresh save dir is a
+    fresh start, not an error (the restart loop's first attempt)."""
+    load_dir = load_dir or os.environ.get(RESUME_DIR_ENV, "").strip()
+    if not load_dir:
+        return None
+    tag = os.environ.get(RESUME_TAG_ENV, "").strip() or None
+    try:
+        # the fallback walk IS the resolve — a separate pre-resolve
+        # would replay every manifest twice per launch.  Prefer the
+        # ENGINE method: stored-layout engines need their canonical↔
+        # stored transform wrapped around the restore.
+        loader = getattr(engine, "load_checkpoint", None)
+        if callable(loader):
+            try:
+                return loader(load_dir, tag=tag, fallback=True)
+            except NotImplementedError:
+                # param-offload checkpoints have no manifest/fallback
+                # yet: resume plain (the pre-durability behavior)
+                return loader(load_dir, tag=tag)
+        return load_checkpoint(engine, load_dir, tag=tag, fallback=True)
+    except (FileNotFoundError, CheckpointVerifyError):
+        log_dist(f"auto-resume: no verified checkpoint under {load_dir}; "
+                 "fresh start", ranks=[0])
+        return None
 
 
 def get_fp32_state_dict_from_checkpoint(checkpoint_dir: str,
@@ -260,7 +945,11 @@ def get_fp32_state_dict_from_checkpoint(checkpoint_dir: str,
     import orbax.checkpoint as ocp
 
     if tag is not None or os.path.isfile(os.path.join(checkpoint_dir, LATEST_FILE)):
-        tag = _resolve_tag(checkpoint_dir, tag)
+        if tag is None:
+            tag = _read_latest_tag(checkpoint_dir)
+            if tag is None:
+                raise FileNotFoundError(
+                    f"no '{LATEST_FILE}' file in {checkpoint_dir}")
         checkpoint_dir = os.path.join(checkpoint_dir, tag)
     state_path = os.path.join(os.path.abspath(checkpoint_dir), MODULE_DIR)
     with ocp.PyTreeCheckpointer() as ckptr:

@@ -7,6 +7,14 @@ per-rank sampler with N processes; here ONE process iterates *global
 micro-batches* (``micro_batch × dp_world`` rows) and the engine shards them
 onto the mesh (multi-host: each host feeds its local shard via
 ``jax.make_array_from_process_local_data``).
+
+Deterministic resume: both loaders expose ``state_dict()`` /
+``load_state_dict()`` capturing (epoch, batch index, shuffle seed) — the
+whole iteration identity, since the shuffle permutation is a pure
+function of ``seed + epoch``.  Checkpoints carry this state (see
+``runtime/checkpointing.py``), so a run killed at step N and resumed
+sees the SAME remaining batch sequence the uninterrupted run would
+have — the data half of bit-exact resume.
 """
 from __future__ import annotations
 
@@ -41,6 +49,12 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _stack
         self._epoch = 0
+        # batches already CONSUMED this epoch (advanced before each
+        # yield returns, so a state_dict taken between next() calls
+        # names exactly the next batch to produce) + the one-shot
+        # fast-forward offset a load_state_dict arms
+        self._batch_index = 0
+        self._resume_batch = 0
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
 
@@ -53,15 +67,45 @@ class DeepSpeedDataLoader:
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
 
+    # -- deterministic-resume state ------------------------------------
+    def state_dict(self) -> dict:
+        """Iteration identity: (epoch, batches consumed this epoch) plus
+        the shuffle parameters that make the order reproducible."""
+        return {"epoch": self._epoch, "batch_index": self._batch_index,
+                "seed": self.seed, "shuffle": self.shuffle,
+                "batch_size": self.batch_size}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Arm the NEXT ``__iter__`` to fast-forward to the captured
+        position.  Seed/shuffle/batch_size must match the capture — a
+        silent mismatch would resume a different batch sequence while
+        claiming determinism."""
+        for key in ("seed", "shuffle", "batch_size"):
+            if key in state and state[key] != getattr(self, key):
+                raise ValueError(
+                    f"dataloader state mismatch on {key!r}: checkpoint "
+                    f"has {state[key]!r}, loader has "
+                    f"{getattr(self, key)!r} — deterministic resume "
+                    "requires the same loader configuration")
+        self._epoch = int(state.get("epoch", 0))
+        self._batch_index = self._resume_batch = \
+            int(state.get("batch_index", 0))
+
     def __iter__(self):
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
             order = np.random.default_rng(self.seed + self._epoch).permutation(n)
-        for start in range(0, n, self.batch_size):
+        start_batch, self._resume_batch = self._resume_batch, 0
+        self._batch_index = start_batch
+        for start in range(start_batch * self.batch_size, n, self.batch_size):
             idx = order[start:start + self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:
                 return
+            # counter moves BEFORE the yield returns: a generator
+            # suspended at `yield` has already delivered this batch, so
+            # post-yield bookkeeping would lag one next() behind
+            self._batch_index += 1
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
 
 
@@ -71,6 +115,18 @@ class RepeatingLoader:
     def __init__(self, loader):
         self.loader = loader
         self.data_iter = iter(self.loader)
+
+    def state_dict(self) -> dict:
+        if not hasattr(self.loader, "state_dict"):
+            return {}
+        return self.loader.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        if hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(state)
+            # restart from the armed position (the old generator would
+            # continue from wherever it was)
+            self.data_iter = iter(self.loader)
 
     def __iter__(self):
         return self

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import re
 import time
 from typing import Any, Callable, Optional
@@ -41,6 +42,7 @@ from ..models.common import TP_RULES
 from ..parallel import zero as zero_lib
 from ..telemetry import (attribution as telemetry_attribution, recompile,
                          registry as telemetry_registry, trace)
+from ..testing import chaos as chaos_mod
 from ..utils import ThroughputTimer, log_dist, logger
 from . import precision
 from .config import Config
@@ -294,6 +296,12 @@ class Engine:
         self.global_samples = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+
+        # bad-step recovery subscriber (runtime/guard.py TrainGuard
+        # attaches itself here); training-site fault injection resolves
+        # the env-named chaos plan exactly like the serving stack does
+        self._train_guard = None
+        chaos_mod.maybe_install_env()
 
         self._state: Optional[TrainState] = None
         self._state_shardings = None
@@ -757,6 +765,93 @@ class Engine:
         if self._state is None:
             raise RuntimeError("parameters not initialized; call engine.init_params(...) "
                                "or pass model_parameters/training data first")
+
+    # ------------------------------------------------------------------
+    # deterministic-resume state (runtime/checkpointing.py meta payload)
+    # ------------------------------------------------------------------
+    def _invalidate_step_caches(self) -> None:
+        """Drop every compiled/traced step closure.  ``_base_rng`` is a
+        closure CONSTANT of the traced step bodies — mutating it without
+        retracing would keep folding the old key."""
+        for name in ("_train_step_body", "_onebit_step_body",
+                     "_pipeline_step_body", "_compiled_train_step",
+                     "_compiled_grads_only", "_compiled_grad_step",
+                     "_compiled_apply_step", "_compiled_eval_step",
+                     "_multi_step_cache"):
+            self.__dict__.pop(name, None)
+
+    def _rng_state(self) -> dict:
+        """JSON-able snapshot of the engine rng key (checkpoint meta)."""
+        key = np.asarray(jax.device_get(self._base_rng))
+        return {"key": key.tolist(), "dtype": str(key.dtype)}
+
+    def _set_rng_state(self, state: dict) -> None:
+        key = np.asarray(state["key"],
+                         dtype=np.dtype(state.get("dtype", "uint32")))
+        cur = np.asarray(jax.device_get(self._base_rng))
+        if cur.shape == key.shape and np.array_equal(cur, key):
+            return       # same key (the common fresh-engine resume): no
+        self._base_rng = jnp.asarray(key)     # recompile needed
+        self._invalidate_step_caches()
+
+    def reseed(self, salt: int) -> None:
+        """Fork the engine rng lane (TrainGuard rollback re-seed: the
+        replayed steps must not retrace the exact bad trajectory)."""
+        self._base_rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.seed), 0x5EED ^ int(salt))
+        self._invalidate_step_caches()
+
+    def _dataloader_state(self) -> Optional[dict]:
+        it = getattr(self, "_train_iter_obj", None)
+        src = it if it is not None else self.training_dataloader
+        if src is None or not hasattr(src, "state_dict"):
+            return None
+        return src.state_dict()
+
+    def _set_dataloader_state(self, state: dict) -> None:
+        if not state:
+            return
+        if self.training_dataloader is None:
+            logger.warning("checkpoint carries dataloader state but this "
+                           "engine has no training_data; ignoring")
+            return
+        self.training_dataloader.load_state_dict(state)
+        # rebuilt (fast-forwarded to the captured position) at next pull
+        self._train_iter_obj = None
+
+    # ------------------------------------------------------------------
+    # training-site chaos (testing/chaos.py; no plan installed = one
+    # attribute load per site per step)
+    # ------------------------------------------------------------------
+    def _train_chaos_sites(self, batch):
+        if chaos_mod.maybe_fire("sigterm_mid_step") is not None:
+            import signal as _signal
+
+            logger.warning("chaos: delivering SIGTERM mid-step "
+                           "(chaos site sigterm_mid_step)")
+            os.kill(os.getpid(), _signal.SIGTERM)
+        if chaos_mod.maybe_fire("nonfinite_grad") is not None:
+            batch = self._poison_batch(batch)
+        return batch
+
+    def _poison_batch(self, batch):
+        """NaN one element of the first floating batch leaf so its
+        micro-batch's grads go non-finite (the ``nonfinite_grad``
+        site's real-world analog: a poisoned sample / device flake)."""
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(jax.device_get(leaf))
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+            arr = np.array(arr, copy=True)
+            arr.reshape(-1)[0] = np.nan
+            leaves[i] = arr
+            logger.warning("chaos: injected NaN into one batch leaf "
+                           "(chaos site nonfinite_grad)")
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        logger.warning("chaos: nonfinite_grad fired but the batch has no "
+                       "floating-point leaf; fire is inert")
+        return batch
 
     # ------------------------------------------------------------------
     # observability plane
@@ -1618,6 +1713,7 @@ class Engine:
             if seqlen < full:
                 batch = jax.tree_util.tree_map(
                     lambda x: x[:, :seqlen] if np.ndim(x) >= 2 else x, batch)
+        batch = self._train_chaos_sites(batch)
         extra = ()
         if self.progressive_layer_drop is not None:
             theta = self.progressive_layer_drop.update_state(self.global_steps)
@@ -1678,6 +1774,15 @@ class Engine:
         if self.fp16_enabled:
             self.skipped_steps += int(jax.device_get(metrics["overflow"]))
         self._tput.stop(result=metrics["loss"])
+        if self._train_guard is not None:
+            # opt-in bad-step recovery (runtime/guard.py): publishes the
+            # per-step loss/grad-norm series the loss_spike /
+            # grad_norm_explosion detectors read, and may roll the
+            # engine back to the last verified checkpoint
+            try:
+                self._train_guard.on_step(metrics)
+            except Exception as e:      # the guard must never kill a step
+                logger.warning(f"train guard on_step failed: {e!r}")
         self._maybe_print(metrics)
         return metrics["loss"]
 
@@ -1772,9 +1877,17 @@ class Engine:
             self.monitor.write_events(events)
 
     # checkpointing lives in runtime/checkpointing.py (wired in M3)
-    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        keep_last_n: int = 0, keep_every: int = 0,
+                        update_latest: bool = True):
         with trace.span("train/checkpoint", step=self.global_steps):
             if self._param_offload is not None:
+                if keep_last_n or keep_every or not update_latest:
+                    # loud > silent: the offload writer would publish
+                    # `latest` unconditionally and never GC
+                    raise NotImplementedError(
+                        "param-offload checkpoints do not support "
+                        "keep_last_n/keep_every/update_latest")
                 return self._param_offload.save_checkpoint(
                     save_dir, tag=tag, client_state=client_state)
             from .checkpointing import save_checkpoint as _save
@@ -1782,28 +1895,40 @@ class Engine:
             self._require_state()
             if not self._has_store_transform:
                 return _save(self, save_dir, tag=tag,
-                             client_state=client_state)
+                             client_state=client_state,
+                             keep_last_n=keep_last_n, keep_every=keep_every,
+                             update_latest=update_latest)
             # checkpoints stay in canonical (global) layer order so any
             # topology/schedule/placement can resume them
             stored = self._state
             self._state = self._transform_train_state(stored, to_stored=False)
             try:
                 return _save(self, save_dir, tag=tag,
-                             client_state=client_state)
+                             client_state=client_state,
+                             keep_last_n=keep_last_n, keep_every=keep_every,
+                             update_latest=update_latest)
             finally:
                 self._state = stored
 
-    def load_checkpoint(self, load_dir, tag=None, strict: bool = True):
+    def load_checkpoint(self, load_dir, tag=None, strict: bool = True,
+                        fallback: bool = False, verify: bool = True):
         if self._param_offload is not None:
+            if fallback:
+                raise NotImplementedError(
+                    "param-offload checkpoints have no integrity "
+                    "manifest yet; fallback=True would silently load "
+                    "unverified")
             return self._param_offload.load_checkpoint(load_dir, tag=tag)
         from .checkpointing import load_checkpoint as _load
 
         if not self._has_store_transform or self._state is None:
-            return _load(self, load_dir, tag=tag, strict=strict)
+            return _load(self, load_dir, tag=tag, strict=strict,
+                         fallback=fallback, verify=verify)
         stored = self._state
         self._state = self._transform_train_state(stored, to_stored=False)
         try:
-            out = _load(self, load_dir, tag=tag, strict=strict)
+            out = _load(self, load_dir, tag=tag, strict=strict,
+                        fallback=fallback, verify=verify)
         finally:
             if self._state is not None:
                 self._state = self._transform_train_state(

@@ -1,0 +1,284 @@
+"""TrainGuard: bad-step recovery over the live training telemetry.
+
+The fp16 path already has a bad-step discipline — non-finite grads skip
+the update (``overflow``), the loss-scale machine backs off.  Nothing
+protects bf16/fp32 runs: a poisoned sample, a device flake, or plain
+divergence NaNs the params and the run keeps burning chips on garbage.
+This module generalizes the skip into a recovery story using the same
+subscriber pattern the serving admission ladder rides
+(``inference/admission.py`` over ``anomaly.subscribe()``):
+
+- the engine publishes per-step ``train_loss`` / ``train_grad_norm``
+  when a guard is attached (the per-step device fetch is the guard's
+  cost — without a guard the engine keeps its report-cadence fetch);
+- the ``loss_spike`` and ``grad_norm_explosion`` hysteresis detectors
+  (``telemetry/anomaly.py``) evaluate the series every step;
+- on sustained firing the guard either **snapshots** the current state
+  (``rollback=False``: a ``guard_step<N>`` checkpoint for forensics —
+  retention GC never touches non-``global_step`` tags) or **rolls
+  back** (``rollback=True``): restore the last VERIFIED checkpoint via
+  the fallback walk, re-seed the engine rng lane so the replayed steps
+  do not retrace the bad trajectory, and quiesce the detectors.
+
+Thread/host discipline: by default the guard evaluates on a PRIVATE
+anomaly engine observed exactly once per ``on_step`` — never from the
+telemetry scrape thread.  That makes the fire decision a deterministic
+function of the (globally pmean'd, hence host-identical) step metrics,
+so every host fires at the same step and enters the restore collective
+together.  Recovery ACTIONS always execute inside ``on_step`` (the
+train thread, between steps), even when a caller wires the guard to a
+shared engine whose ``observe()`` also runs on the scrape thread — an
+event from another thread is parked and executed at the next step
+boundary, never concurrently with a train step.
+
+Attach with ``TrainGuard(engine, save_dir, rollback=True)``; the guard
+hooks ``engine.train_batch`` automatically.  Chaos site
+``nonfinite_grad`` (``testing/chaos.py``) is the seeded proof: inject a
+NaN micro-batch, the guard must recover.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..telemetry import anomaly as telemetry_anomaly
+from ..telemetry import registry as telemetry_registry
+from ..utils.logging import log_dist, logger
+
+__all__ = ["TrainGuard", "GUARD_RULES"]
+
+GUARD_RULES = ("loss_spike", "grad_norm_explosion")
+_GUARD_SERIES = ("train_loss", "train_grad_norm")
+_MAX_FINITE_WALK = 4
+
+
+class TrainGuard:
+    """Opt-in bad-step recovery subscriber.
+
+    ``rollback=False`` (default): checkpoint the current state under a
+    ``guard_step<N>`` tag when a guard rule fires — the diverging state
+    is preserved for a postmortem and the run continues.
+    ``rollback=True``: restore the last verified checkpoint
+    (``load_checkpoint(fallback=True)``), re-seed, continue.
+    ``cooldown_steps`` suppresses re-triggering while the just-recovered
+    run rebuilds detector history.  ``anomaly_engine`` defaults to a
+    private engine evaluated once per step (see the module docstring
+    for why the process singleton is NOT the default).
+    """
+
+    def __init__(self, engine, save_dir: str, rollback: bool = False,
+                 cooldown_steps: int = 8,
+                 anomaly_engine: Optional[
+                     telemetry_anomaly.AnomalyEngine] = None):
+        if getattr(engine, "_param_offload", None) is not None:
+            raise NotImplementedError(
+                "TrainGuard does not support param-offload engines: "
+                "their checkpoint path has no manifest/fallback, so "
+                "neither rollback nor a non-latest snapshot is possible")
+        self.engine = engine
+        self.save_dir = save_dir
+        self.rollback = rollback
+        self.cooldown_steps = cooldown_steps
+        self.rollbacks = 0
+        self.snapshots = 0
+        self.failures = 0
+        self.last_event: Optional[dict] = None
+        self._pending_event: Optional[dict] = None
+        if anomaly_engine is None:
+            # private engine, observed ONLY from on_step: deterministic
+            # one-evaluation-per-step hysteresis (host-identical), and
+            # no scrape-thread evaluation can ever trigger an action
+            anomaly_engine = telemetry_anomaly.AnomalyEngine(detectors=[
+                telemetry_anomaly.LossSpikeDetector(),
+                telemetry_anomaly.GradNormExplosionDetector()])
+        self._anomaly = anomaly_engine
+        # a custom detector list may lack the guard rules; the guard is
+        # useless without them, so append what is missing
+        have = {d.name for d in self._anomaly.detectors}
+        if "loss_spike" not in have:
+            self._anomaly.detectors.append(
+                telemetry_anomaly.LossSpikeDetector())
+        if "grad_norm_explosion" not in have:
+            self._anomaly.detectors.append(
+                telemetry_anomaly.GradNormExplosionDetector())
+        self._g_loss = telemetry_registry.gauge(
+            "train_loss", "loss at last report")
+        self._g_gnorm = telemetry_registry.gauge(
+            "train_grad_norm", "grad norm at last report")
+        self._m_rollbacks = telemetry_registry.counter(
+            "train_guard_rollbacks_total",
+            "guard-triggered restores of the last verified checkpoint")
+        self._m_snapshots = telemetry_registry.counter(
+            "train_guard_snapshots_total",
+            "guard-triggered forensic state snapshots")
+        self._cooldown_until = -1
+        self._unsubscribe = self._anomaly.subscribe(self._on_event)
+        engine._train_guard = self
+
+    # -- the engine-side hook (train_batch calls this per step) --------
+    def on_step(self, metrics: dict) -> None:
+        """Publish the step's loss/grad-norm, evaluate the detectors
+        NOW (``force=True`` skips the 1/s throttle: hysteresis counts
+        evaluations, and the guard wants exactly one per step), and
+        execute any pending recovery action on THIS thread, between
+        steps."""
+        self._g_loss.set(float(jax.device_get(metrics["loss"])))
+        self._g_gnorm.set(float(jax.device_get(metrics["grad_norm"])))
+        self._anomaly.observe(force=True)
+        ev, self._pending_event = self._pending_event, None
+        if ev is not None:
+            self._act(ev)
+
+    # -- the anomaly subscriber ----------------------------------------
+    def _on_event(self, ev: dict) -> None:
+        """May run on ANY thread that calls the anomaly engine's
+        observe (the scrape thread, when wired to a shared engine):
+        only PARK the event — the action runs at the next step
+        boundary, never concurrently with a train step."""
+        if ev.get("state") != "firing" or ev.get("rule") not in GUARD_RULES:
+            return
+        self._pending_event = dict(ev)
+
+    def _act(self, ev: dict) -> None:
+        if self.engine.global_steps < self._cooldown_until:
+            return
+        # armed BEFORE the action (a failed recovery must not retry
+        # every step) and re-anchored after: a rollback rewinds
+        # global_steps, and a pre-rollback anchor would leave the guard
+        # blind for the whole replayed window, not cooldown_steps
+        self._cooldown_until = self.engine.global_steps + self.cooldown_steps
+        self.last_event = dict(ev)
+        try:
+            if self.rollback:
+                self._do_rollback(ev)
+            else:
+                self._do_snapshot(ev)
+        except Exception as e:
+            # loud, attributed failure — the anomaly fan-out upstream
+            # swallows subscriber exceptions silently
+            self.failures += 1
+            logger.error(
+                f"train guard: {'rollback' if self.rollback else 'snapshot'}"
+                f" for {ev['rule']} FAILED: {e!r} — training continues "
+                "unrecovered")
+        self._cooldown_until = self.engine.global_steps + self.cooldown_steps
+
+    def _do_snapshot(self, ev: dict) -> None:
+        tag = f"guard_step{self.engine.global_steps}"
+        logger.warning(
+            f"train guard: {ev['rule']} firing "
+            f"(value={ev.get('value')}) — snapshotting state to {tag!r}")
+        # update_latest=False: a snapshot OF DIVERGING STATE must never
+        # become what a restart resumes from
+        self.engine.save_checkpoint(
+            self.save_dir, tag=tag, update_latest=False,
+            client_state={"guard_event": {
+                "rule": ev["rule"], "value": ev.get("value"),
+                "threshold": ev.get("threshold"), "t": time.time()}})
+        self.snapshots += 1
+        self._m_snapshots.inc()
+
+    def _params_finite(self) -> bool:
+        for leaf in jax.tree_util.tree_leaves(
+                jax.device_get(self.engine.state.params)):
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.floating) \
+                    and not np.isfinite(arr).all():
+                return False
+        return True
+
+    def _do_rollback(self, ev: dict) -> None:
+        from .checkpointing import (_candidate_tags, point_latest,
+                                    verify_checkpoint)
+
+        logger.warning(
+            f"train guard: {ev['rule']} firing (value={ev.get('value')}) "
+            f"— rolling back to the last verified checkpoint")
+        # an interval save scheduled BETWEEN the bad step and detection
+        # holds the diverged state: committing it would repoint `latest`
+        # at exactly what this rollback undoes
+        mgr = getattr(self.engine, "_ckpt_manager", None)
+        if mgr is not None:
+            mgr.discard_pending()
+        # through the ENGINE method, not the module function: a
+        # stored-layout engine (interleaved/placed stacks) needs its
+        # canonical↔stored transform wrapped around the restore
+        ckpt_dir, _client = self.engine.load_checkpoint(
+            self.save_dir, fallback=True)
+        # the diverged state may already be COMMITTED (an interval save
+        # landed before the detector's hysteresis fired) and a NaN
+        # checkpoint verifies clean — integrity ≠ health.  Walk further
+        # back, lazily (verify each candidate at most once, newest
+        # first), until the restored params are finite.
+        if not self._params_finite():
+            restored = self.engine.global_steps
+            walked = False
+            candidates = [t for s, _m, t in _candidate_tags(self.save_dir)
+                          if 0 <= s < restored][:_MAX_FINITE_WALK]
+            for tag in candidates:
+                if verify_checkpoint(os.path.join(self.save_dir, tag)):
+                    continue
+                logger.warning(
+                    f"train guard: restored params non-finite; walking "
+                    f"back to {tag!r}")
+                ckpt_dir, _client = self.engine.load_checkpoint(
+                    self.save_dir, tag=tag)
+                if self._params_finite():
+                    walked = True
+                    break
+            if not walked and not self._params_finite():
+                logger.warning(
+                    "train guard: no older finite checkpoint to walk "
+                    "back to; keeping the restored state")
+        # everything newer than the restored tag is the diverged
+        # trajectory: demote it out of the resolve/fallback candidate
+        # space (renamed, not deleted — it is postmortem evidence) and
+        # repoint `latest`, so a crash before the replay overtakes the
+        # old high-water mark resumes from here, not from the bad state
+        self.rollbacks += 1
+        self._demote_diverged()
+        point_latest(self.save_dir, os.path.basename(ckpt_dir))
+        # replaying the exact rng lane would replay the exact bad step
+        # when the fault is data/seed-coupled; fork it
+        self.engine.reseed(self.rollbacks)
+        # pre-rollback samples are not evidence about the restored state
+        self._anomaly.reset_rules(GUARD_RULES, series=_GUARD_SERIES)
+        self._m_rollbacks.inc()
+        log_dist(
+            f"train guard: restored {ckpt_dir} at step "
+            f"{self.engine.global_steps} (rollback #{self.rollbacks}), "
+            "rng lane re-seeded", ranks=[0])
+
+    def _demote_diverged(self) -> None:
+        """Rename committed ``global_step<N>`` dirs NEWER than the
+        restored step to ``diverged_step<N>_r<k>``: they verify clean
+        (integrity ≠ health), so leaving them in place would let a
+        later fallback walk resume the very trajectory this rollback
+        undid the moment the restored checkpoint rots."""
+        from .checkpointing import _candidate_tags
+
+        if jax.process_index() != 0:
+            return
+        for step, _mt, tag in _candidate_tags(self.save_dir):
+            if step <= self.engine.global_steps:
+                continue
+            src = os.path.join(self.save_dir, tag)
+            dst = os.path.join(self.save_dir,
+                               f"diverged_step{step}_r{self.rollbacks}")
+            try:
+                os.rename(src, dst)
+                logger.warning(f"train guard: demoted diverged "
+                               f"checkpoint {tag!r} to "
+                               f"{os.path.basename(dst)!r}")
+            except OSError as e:
+                logger.warning(
+                    f"train guard: could not demote {tag!r}: {e!r}")
+
+    def close(self) -> None:
+        self._unsubscribe()
+        if getattr(self.engine, "_train_guard", None) is self:
+            self.engine._train_guard = None

@@ -49,7 +49,8 @@ from . import registry as _registry
 __all__ = [
     "Series", "Detector", "RecompileStormDetector", "SloBurnDetector",
     "QueueRunawayDetector", "AcceptanceCollapseDetector",
-    "GoodputDropDetector", "AttributionDriftDetector", "AnomalyEngine",
+    "GoodputDropDetector", "AttributionDriftDetector",
+    "LossSpikeDetector", "GradNormExplosionDetector", "AnomalyEngine",
     "get_engine", "observe", "subscribe", "active", "recent", "status",
     "install",
 ]
@@ -105,6 +106,13 @@ class Series:
             return False
         tail = [v for _, v in list(self._xs)[-(k + 1):]]
         return all(b > a for a, b in zip(tail, tail[1:]))
+
+    def tail(self, n: int) -> List[float]:
+        """The last ``n`` values (fewer when the series is shorter)."""
+        return [v for _, v in list(self._xs)[-n:]]
+
+    def clear(self) -> None:
+        self._xs.clear()
 
 
 def _metric_total(name: str) -> Optional[float]:
@@ -167,6 +175,15 @@ class Detector:
                 "value": violation.get("value"),
                 "threshold": violation.get("threshold"),
                 "detail": violation.get("detail", {})}
+
+    def reset(self) -> None:
+        """Back to the quiescent state WITHOUT emitting a clear event —
+        the TrainGuard calls this after a rollback (the pre-rollback
+        samples are no longer evidence about the restored state)."""
+        self.firing = False
+        self._bad = 0
+        self._good = 0
+        self._last_violation = None
 
 
 class RecompileStormDetector(Detector):
@@ -374,15 +391,104 @@ class AttributionDriftDetector(Detector):
         return events
 
 
+def _finite_median(xs: List[float]) -> Optional[float]:
+    import math
+
+    vals = sorted(v for v in xs if math.isfinite(v))
+    if not vals:
+        return None
+    return vals[len(vals) // 2]
+
+
+class _TrailingRatioDetector(Detector):
+    """Shared machinery for the train-series guard rules: the series'
+    last sample went non-finite, or rose more than
+    ``ratio × max(|baseline|, min_scale)`` ABOVE the baseline (median
+    of the trailing ``history`` finite samples, excluding the suspect
+    sample itself).  The deviation-from-baseline form stays meaningful
+    for negative objectives (ELBO/log-likelihood losses, where a plain
+    ``last > ratio·median`` fires on every healthy step) and the
+    ``min_scale`` floor keeps a converged near-zero baseline from
+    flagging numeric jitter.  The series moves per-step only while a
+    ``TrainGuard`` is attached (the per-step device fetch is the
+    guard's cost); otherwise it moves at the engine's report cadence
+    and the rule stays quiet."""
+
+    fire_after = 2
+    clear_after = 3
+    series_name = ""            # subclass: which engine.series to read
+    env_prefix = ""             # subclass: DSTPU_ALERT_<prefix>_{RATIO,HISTORY}
+    default_ratio = 3.0
+    min_scale = 1e-3
+
+    def __init__(self, ratio: Optional[float] = None,
+                 history: Optional[int] = None):
+        super().__init__()
+        self.ratio = _envf(f"DSTPU_ALERT_{self.env_prefix}_RATIO",
+                           self.default_ratio) if ratio is None else ratio
+        self.history = max(4, _envi(f"DSTPU_ALERT_{self.env_prefix}_HISTORY",
+                                    8) if history is None else history)
+
+    def thresholds(self) -> dict:
+        return {"ratio": self.ratio, "history": self.history}
+
+    def check(self, engine, now):
+        import math
+
+        s = engine.series[self.series_name]
+        last = s.last()
+        if last is None:
+            return None
+        if not math.isfinite(last):
+            return {"value": last, "threshold": None,
+                    "detail": {"nonfinite": True}}
+        tail = s.tail(self.history + 1)[:-1]      # exclude the suspect
+        if len(tail) < self.history // 2:
+            return None                            # not enough baseline
+        base = _finite_median(tail)
+        if base is None:
+            return None
+        threshold = base + self.ratio * max(abs(base), self.min_scale)
+        if last > threshold:
+            return {"value": last, "threshold": threshold,
+                    "detail": {"median": base, "ratio": self.ratio}}
+        return None
+
+
+class LossSpikeDetector(_TrailingRatioDetector):
+    """``train_loss`` non-finite or ``ratio``× above trailing-median.
+    Knobs: ``DSTPU_ALERT_LOSS_SPIKE_RATIO`` (3.0),
+    ``DSTPU_ALERT_LOSS_SPIKE_HISTORY`` (8, min 4)."""
+
+    name = "loss_spike"
+    series_name = "train_loss"
+    env_prefix = "LOSS_SPIKE"
+    default_ratio = 3.0
+
+
+class GradNormExplosionDetector(_TrailingRatioDetector):
+    """``train_grad_norm`` non-finite or ``ratio``× above
+    trailing-median — the fp16 ``overflow``-skip signal generalized:
+    under bf16/fp32 nothing else stops a NaN from reaching the
+    optimizer.  Knobs: ``DSTPU_ALERT_GRAD_NORM_RATIO`` (10.0),
+    ``DSTPU_ALERT_GRAD_NORM_HISTORY`` (8)."""
+
+    name = "grad_norm_explosion"
+    series_name = "train_grad_norm"
+    env_prefix = "GRAD_NORM"
+    default_ratio = 10.0
+
+
 def default_detectors() -> List[Detector]:
     return [RecompileStormDetector(), SloBurnDetector(),
             QueueRunawayDetector(), AcceptanceCollapseDetector(),
-            GoodputDropDetector(), AttributionDriftDetector()]
+            GoodputDropDetector(), AttributionDriftDetector(),
+            LossSpikeDetector(), GradNormExplosionDetector()]
 
 
 _SOURCES = ("recompiles", "slo_met", "slo_violations", "queue_depth",
             "acceptance_rate", "verify_ticks", "goodput_ratio",
-            "goodput_wall")
+            "goodput_wall", "train_loss", "train_grad_norm")
 
 _MIN_OBSERVE_INTERVAL_S = 1.0
 _EVENT_RING = 256
@@ -425,6 +531,8 @@ class AnomalyEngine:
         put("queue_depth", _metric_total("serving_queue_depth"))
         put("acceptance_rate", _metric_total("specdec_acceptance_rate"))
         put("verify_ticks", _metric_total("specdec_verify_ticks_total"))
+        put("train_loss", _metric_total("train_loss"))
+        put("train_grad_norm", _metric_total("train_grad_norm"))
         try:
             from . import goodput as _goodput
 
@@ -532,6 +640,26 @@ class AnomalyEngine:
             self._record(ev)
         self._emit(ev)
         return ev
+
+    def reset_rules(self, names, series=()) -> None:
+        """Quiesce the named rules (and optionally clear source series)
+        WITHOUT emitting clear transitions: after a TrainGuard rollback
+        the pre-rollback samples say nothing about the restored state,
+        and a synthetic "cleared" event would unwind subscribers that
+        never saw the firing resolve for real."""
+        wanted = set(names)
+        with self._lock:
+            for d in self.detectors:
+                if d.name in wanted:
+                    d.reset()
+            for key in [k for k, ev in self._active.items()
+                        if ev["rule"] in wanted]:
+                self._active.pop(key, None)
+            for s in series:
+                if s in self.series:
+                    self.series[s].clear()
+        for name in wanted:
+            self._m_firing.labels(rule=name).set(0.0)
 
     # -- the consumer seam ---------------------------------------------
     def subscribe(self, fn: Callable[[dict], None]) -> Callable[[], None]:

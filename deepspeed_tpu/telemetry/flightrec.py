@@ -41,7 +41,8 @@ from ..utils.logging import logger
 from . import registry as _registry
 
 __all__ = ["FlightRecorder", "get_recorder", "maybe_install", "mark",
-           "dump", "pretty", "add_sigterm_hook", "FLIGHT_DIR_ENV"]
+           "dump", "pretty", "add_sigterm_hook", "sigterm_managed",
+           "FLIGHT_DIR_ENV"]
 
 # separate override for the rare case flight dumps should land away from
 # the metrics dir; defaults to DSTPU_METRICS_DIR
@@ -252,6 +253,20 @@ def dump(reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
 
 
 _sigterm_hooks: list = []
+
+
+def sigterm_managed() -> bool:
+    """True while the flight recorder's handler owns SIGTERM — the
+    signal an :func:`add_sigterm_hook` hook will actually run under.
+    Subsystems that want a SIGTERM side effect (the
+    ``AsyncCheckpointManager`` preemption save) check this first:
+    when the recorder owns the signal they must REGISTER A HOOK, not
+    ``signal.signal`` over the handler (which would silently drop the
+    dump/flush/drain chain — and every other registered hook)."""
+    try:
+        return signal.getsignal(signal.SIGTERM) is _on_signal
+    except (ValueError, OSError):
+        return False
 
 
 def add_sigterm_hook(fn):

@@ -39,7 +39,7 @@ __all__ = [
     "run_collectors", "METRICS_DIR_ENV", "pct",
     "render_prometheus_snapshot",
     "SECONDS_BUCKETS", "MS_BUCKETS", "TPOT_MS_BUCKETS",
-    "ACCEPT_LEN_BUCKETS", "BUCKET_SCHEMAS",
+    "ACCEPT_LEN_BUCKETS", "BYTES_BUCKETS", "BUCKET_SCHEMAS",
 ]
 
 METRICS_DIR_ENV = "DSTPU_METRICS_DIR"
@@ -88,12 +88,18 @@ TPOT_MS_BUCKETS: Tuple[float, ...] = (
 # sane k without re-registering per config
 ACCEPT_LEN_BUCKETS: Tuple[float, ...] = (
     0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+# byte-sized payloads (checkpoint writes, parked caches): KiB test
+# fixtures through TiB-scale production checkpoints
+BYTES_BUCKETS: Tuple[float, ...] = (
+    1 << 10, 64 << 10, 1 << 20, 16 << 20, 128 << 20, 1 << 30,
+    8 << 30, 64 << 30, 512 << 30, 1 << 42)
 
 BUCKET_SCHEMAS: Dict[str, Tuple[float, ...]] = {
     "seconds": SECONDS_BUCKETS,
     "ms": MS_BUCKETS,
     "tpot_ms": TPOT_MS_BUCKETS,
     "accept_len": ACCEPT_LEN_BUCKETS,
+    "bytes": BYTES_BUCKETS,
 }
 
 

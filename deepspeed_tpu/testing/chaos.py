@@ -28,6 +28,29 @@ Named sites (each is a real failure mode the stack must survive):
   503 (a wedged observer).  Serving must be unaffected; a fleet
   aggregator sees the replica degrade, not the process die.
 
+Training sites (the other half of the fault surface — a multi-day run
+on preemptible TPUs dies on exactly these):
+
+- ``ckpt_save_failure`` — the checkpoint commit aborts mid-write (a
+  storage fault / preemption landing inside the save), leaving a TORN
+  directory: shards written, no ``MANIFEST``/``engine_state.json``/
+  ``latest``.  The next save and the retention GC must tolerate the
+  debris; ``verify_checkpoint`` must reject it.
+- ``ckpt_corrupt_shard`` — one bit of a COMMITTED checkpoint file is
+  flipped after publish (silent storage corruption).  ``verify``
+  must catch it and ``load_checkpoint(fallback=True)`` must walk back
+  to the previous verified checkpoint.
+- ``sigterm_mid_step`` — SIGTERM delivered to the training process
+  mid-step (the TPU/GKE preemption signal).  The
+  ``AsyncCheckpointManager`` handler chain must flag/save and the run
+  must resume from the preemption checkpoint.
+- ``nonfinite_grad`` — NaN injected into one micro-batch's inputs so
+  its gradients go non-finite (a poisoned sample / device flake).  The
+  fp16 overflow-skip or the ``TrainGuard`` rollback must recover.
+  (The site poisons the first floating-point batch leaf; an
+  integer-only batch cannot produce the fault and the fire is logged
+  as inert.)
+
 Determinism: each site keeps its own invocation counter (counting from
 plan install), and a :class:`FaultSpec` fires on exact invocation
 indices (``at``), a period (``every``), or a seeded per-site coin
@@ -73,6 +96,11 @@ SITES: Tuple[str, ...] = (
     "slow_tick",
     "drafter_exception",
     "exporter_blackhole",
+    # training sites (runtime/checkpointing.py, runtime/engine.py)
+    "ckpt_save_failure",
+    "ckpt_corrupt_shard",
+    "sigterm_mid_step",
+    "nonfinite_grad",
 )
 
 

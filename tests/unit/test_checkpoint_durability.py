@@ -1,0 +1,587 @@
+"""Checkpoint durability: integrity manifest, retention GC, fallback
+walk, atomic metadata, SIGTERM chaining, dataloader resume state, and
+the TrainGuard detectors (ISSUE 15 tentpole).  E2E interrupted-resume
+bit-exactness and chaos-site recovery live in ``test_zdurability.py``."""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.runtime import checkpointing as ckpt
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+from deepspeed_tpu.runtime.guard import TrainGuard
+from deepspeed_tpu.telemetry import anomaly
+
+from .simple_model import SimpleModel, random_dataset
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+@pytest.fixture(autouse=True)
+def no_chaos():
+    from deepspeed_tpu.testing import chaos
+
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def make_engine(stage=0, lr=1e-2):
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "adam", "params": {"lr": lr}},
+           "zero_optimization": {"stage": stage},
+           "steps_per_print": 10**6}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(), config=cfg)
+    engine.init_params()
+    return engine
+
+
+def batch(engine, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(engine.train_batch_size, 16)).astype(np.float32)
+    return {"x": x, "y": 0.1 * x}
+
+
+def _largest_file(ckpt_dir):
+    best = None
+    for root, _d, files in os.walk(ckpt_dir):
+        for fn in files:
+            if fn == ckpt.MANIFEST_FILE:
+                continue
+            p = os.path.join(root, fn)
+            sz = os.path.getsize(p)
+            if best is None or sz > best[0]:
+                best = (sz, p)
+    return best[1]
+
+
+def _flip_byte(path, offset=None):
+    size = os.path.getsize(path)
+    off = size // 2 if offset is None else offset
+    with open(path, "r+b") as fh:
+        fh.seek(off)
+        b = fh.read(1)
+        fh.seek(off)
+        fh.write(bytes([b[0] ^ 0x80]))
+
+
+# ---------------- manifest + verify ----------------
+
+def test_manifest_written_and_verifies(tmp_path):
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    ckpt_dir = e.save_checkpoint(str(tmp_path))
+    mpath = os.path.join(ckpt_dir, ckpt.MANIFEST_FILE)
+    assert os.path.isfile(mpath)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    rels = {f["path"] for f in manifest["files"]}
+    assert ckpt.ENGINE_STATE_FILE in rels
+    assert any(r.startswith(ckpt.MODULE_DIR) for r in rels)
+    assert manifest["total_bytes"] > 0
+    assert manifest["engine"]["global_steps"] == 1
+    # every file is hashed one way or the other
+    assert all("sha256" in f or "spot_sha256" in f
+               for f in manifest["files"])
+    assert ckpt.verify_checkpoint(ckpt_dir) == []
+
+
+def test_verify_catches_flipped_byte(tmp_path):
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    ckpt_dir = e.save_checkpoint(str(tmp_path))
+    target = _largest_file(ckpt_dir)
+    _flip_byte(target)
+    problems = ckpt.verify_checkpoint(ckpt_dir)
+    assert problems, "bit flip must not verify"
+    assert any(os.path.basename(target) in p for p in problems)
+    _flip_byte(target)               # flip back: verifies again
+    assert ckpt.verify_checkpoint(ckpt_dir) == []
+
+
+def test_verify_catches_truncation_and_missing(tmp_path):
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    ckpt_dir = e.save_checkpoint(str(tmp_path))
+    target = _largest_file(ckpt_dir)
+    with open(target, "r+b") as fh:
+        fh.truncate(os.path.getsize(target) - 1)
+    assert any("size mismatch" in p
+               for p in ckpt.verify_checkpoint(ckpt_dir))
+    os.remove(target)
+    assert any("missing file" in p
+               for p in ckpt.verify_checkpoint(ckpt_dir))
+
+
+def test_verify_rejects_torn_dir(tmp_path):
+    torn = tmp_path / "global_step9"
+    (torn / "module").mkdir(parents=True)
+    (torn / "module" / "shard0").write_bytes(b"partial")
+    problems = ckpt.verify_checkpoint(str(torn))
+    assert any("torn" in p for p in problems)
+
+
+def test_spot_hash_large_file(tmp_path, monkeypatch):
+    """Files above the full-hash cap get the bounded spot hash, which
+    still catches head/tail corruption and truncation."""
+    monkeypatch.setenv("DSTPU_CKPT_HASH_FULL_MAX_BYTES", "1024")
+    d = tmp_path / "global_step1"
+    d.mkdir()
+    payload = bytes(range(256)) * 1024          # 256 KiB > 1 KiB cap
+    (d / "bigshard").write_bytes(payload)
+    manifest = ckpt.write_manifest(str(d))
+    entry = next(f for f in manifest["files"] if f["path"] == "bigshard")
+    assert "spot_sha256" in entry and "sha256" not in entry
+    assert ckpt.verify_checkpoint(str(d)) == []
+    _flip_byte(str(d / "bigshard"), offset=10)   # head corruption
+    assert any("spot-hash mismatch" in p
+               for p in ckpt.verify_checkpoint(str(d)))
+
+
+# ---------------- atomic metadata ----------------
+
+def test_atomic_write_leaves_original_on_failure(tmp_path, monkeypatch):
+    path = tmp_path / "latest"
+    path.write_text("global_step1")
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("injected replace failure")
+
+    monkeypatch.setattr(ckpt.os, "replace", boom)
+    with pytest.raises(OSError):
+        ckpt._atomic_write_text(str(path), "global_step2")
+    monkeypatch.setattr(ckpt.os, "replace", real_replace)
+    # the published file was never torn
+    assert path.read_text() == "global_step1"
+
+
+def test_publish_leaves_no_tmp_files(tmp_path):
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    ckpt_dir = e.save_checkpoint(str(tmp_path))
+    leftovers = [os.path.join(r, f)
+                 for r, _d, fs in os.walk(tmp_path) for f in fs
+                 if ".tmp." in f]
+    assert leftovers == []
+    assert (tmp_path / "latest").read_text() == "global_step1"
+    assert json.load(open(os.path.join(
+        ckpt_dir, ckpt.ENGINE_STATE_FILE)))["global_steps"] == 1
+
+
+# ---------------- retention GC ----------------
+
+def _fake_ckpt(save_dir, tag, committed=True):
+    d = os.path.join(save_dir, tag)
+    os.makedirs(os.path.join(d, "module"), exist_ok=True)
+    with open(os.path.join(d, "module", "shard0"), "wb") as fh:
+        fh.write(tag.encode() * 8)
+    if committed:
+        ckpt.write_manifest(d)
+    return d
+
+
+def test_gc_keep_rules_never_touch_latest_or_inflight(tmp_path):
+    sd = str(tmp_path)
+    for step in (2, 4, 6, 8):
+        _fake_ckpt(sd, f"global_step{step}")
+    _fake_ckpt(sd, "global_step5", committed=False)      # torn debris
+    _fake_ckpt(sd, "guard_step7")                        # not GC's to manage
+    (tmp_path / "latest").write_text("global_step2")     # old but pointed-at
+    deleted = ckpt.gc_checkpoints(sd, keep_last_n=2,
+                                  protect=("global_step4",))
+    assert sorted(deleted) == ["global_step5"]           # torn dir collected
+    kept = {p.name for p in tmp_path.iterdir() if p.is_dir()}
+    # newest 2 committed + latest-pointed + protected(in-flight) + guard tag
+    assert kept == {"global_step8", "global_step6", "global_step4",
+                    "global_step2", "guard_step7"}
+
+
+def test_gc_keep_every_archival_points(tmp_path):
+    sd = str(tmp_path)
+    for step in (1, 2, 3, 4, 5, 6):
+        _fake_ckpt(sd, f"global_step{step}")
+    (tmp_path / "latest").write_text("global_step6")
+    deleted = ckpt.gc_checkpoints(sd, keep_last_n=1, keep_every=3)
+    assert sorted(deleted) == ["global_step1", "global_step2",
+                               "global_step4", "global_step5"]
+    kept = {p.name for p in tmp_path.iterdir() if p.is_dir()}
+    assert kept == {"global_step6", "global_step3"}      # newest + %3
+
+
+def test_gc_disabled_without_keep_last_n(tmp_path):
+    sd = str(tmp_path)
+    for step in (1, 2, 3):
+        _fake_ckpt(sd, f"global_step{step}")
+    assert ckpt.gc_checkpoints(sd) == []
+    assert ckpt.gc_checkpoints(sd, keep_every=1) == []
+    assert len([p for p in tmp_path.iterdir() if p.is_dir()]) == 3
+
+
+# ---------------- fallback walk + auto-resume resolve ----------------
+
+def test_fallback_walk_order(tmp_path):
+    e = make_engine()
+    dirs = {}
+    for i in range(3):
+        e.train_batch(batch(e, i))
+        dirs[e.global_steps] = e.save_checkpoint(str(tmp_path))
+    # newest (step3) corrupt, step2 torn → fallback restores step1
+    # (torn = died before ANY metadata: manifest-less dirs that still
+    # carry engine_state.json are tolerated as pre-durability legacy)
+    _flip_byte(_largest_file(dirs[3]))
+    os.remove(os.path.join(dirs[2], ckpt.MANIFEST_FILE))
+    os.remove(os.path.join(dirs[2], ckpt.ENGINE_STATE_FILE))
+    with pytest.raises(ckpt.CheckpointVerifyError):
+        e.load_checkpoint(str(tmp_path))                  # no fallback
+    mesh_mod.set_mesh(None)
+    e2 = make_engine()
+    ckpt_dir, _ = e2.load_checkpoint(str(tmp_path), fallback=True)
+    assert ckpt_dir.endswith("global_step1")
+    assert e2.global_steps == 1
+
+
+def test_fallback_with_explicit_tag_only_walks_back(tmp_path):
+    """A pinned tag that fails verify must fall back to an OLDER
+    checkpoint, never a newer one (the caller rewound on purpose)."""
+    sd = str(tmp_path)
+    for step in (1, 2, 3):
+        _fake_ckpt(sd, f"global_step{step}")
+    target = os.path.join(sd, "global_step2", "module", "shard0")
+    _flip_byte(target, offset=2)
+    tag, skipped = ckpt._resolve_verified(sd, "global_step2",
+                                          fallback=True, verify=True)
+    assert tag == "global_step1"
+    assert [t for t, _p in skipped] == ["global_step2"]
+
+
+def test_legacy_premanifest_checkpoints_tolerated(tmp_path):
+    """Pre-durability dirs (engine_state.json, no MANIFEST) are
+    committed checkpoints, not torn debris: verify accepts them and GC
+    counts them toward the keep window instead of deleting them."""
+    sd = str(tmp_path)
+    for step in (1, 2):
+        d = _fake_ckpt(sd, f"global_step{step}", committed=False)
+        with open(os.path.join(d, ckpt.ENGINE_STATE_FILE), "w") as fh:
+            json.dump({"global_steps": step}, fh)
+    _fake_ckpt(sd, "global_step3")                       # new-style
+    _fake_ckpt(sd, "global_step4", committed=False)      # torn debris
+    (tmp_path / "latest").write_text("global_step3")
+    assert ckpt.verify_checkpoint(os.path.join(sd, "global_step2")) == []
+    assert ckpt.verify_checkpoint(os.path.join(sd, "global_step4"))
+    deleted = ckpt.gc_checkpoints(sd, keep_last_n=3)
+    assert sorted(deleted) == ["global_step4"]           # debris only
+    kept = {p.name for p in tmp_path.iterdir() if p.is_dir()}
+    assert kept == {"global_step1", "global_step2", "global_step3"}
+
+
+def test_rollback_discards_pending_async_save(tmp_path):
+    """A guard rollback must drop the manager's in-flight save: it
+    holds the DIVERGED state, and committing it would repoint `latest`
+    at exactly what the rollback undid."""
+    e = make_engine()
+    mgr = ckpt.AsyncCheckpointManager(e, str(tmp_path),
+                                      install_sigterm=False)
+    guard = TrainGuard(e, str(tmp_path), rollback=True,
+                       anomaly_engine=anomaly.AnomalyEngine(detectors=[
+                           anomaly.LossSpikeDetector(ratio=3.0,
+                                                     history=4)]))
+    try:
+        for i in range(4):
+            e.train_batch(batch(e, i))
+        mgr.save(sync=True)                    # committed: global_step4
+        e.train_batch(batch(e, 9))
+        mgr.save()                             # pending:   global_step5
+        assert mgr._pending is not None
+        for _ in range(4):                     # synthetic sustained spike
+            guard.on_step({"loss": np.float32(1e6),
+                           "grad_norm": np.float32(0.1)})
+        assert guard.rollbacks == 1
+        assert mgr._pending is None            # discarded, not committed
+        assert e.global_steps == 4
+        assert (tmp_path / "latest").read_text() == "global_step4"
+        # the never-published dir is removed, not left to fail every
+        # future resolve walk
+        assert not (tmp_path / "global_step5").exists()
+    finally:
+        guard.close()
+        mgr.close()
+    # close() finalizes nothing (pending was discarded): latest stays
+    assert (tmp_path / "latest").read_text() == "global_step4"
+
+
+def test_sync_save_gc_protects_inflight_async(tmp_path):
+    """GC triggered by a SYNC save must not collect the manager's
+    manifest-less in-flight dir (it looks exactly like torn debris
+    while orbax writes)."""
+    e = make_engine()
+    mgr = ckpt.AsyncCheckpointManager(e, str(tmp_path),
+                                      install_sigterm=False)
+    try:
+        e.train_batch(batch(e, 0))
+        mgr.save(sync=True)                    # committed: global_step1
+        e.train_batch(batch(e, 1))
+        mgr.save()                             # pending:   global_step2
+        e.train_batch(batch(e, 2))
+        e.save_checkpoint(str(tmp_path), keep_last_n=1)   # global_step3
+        assert (tmp_path / "global_step2").is_dir()   # in-flight survived
+        assert not (tmp_path / "global_step1").exists()   # retention
+        mgr.wait()                             # commit publishes cleanly
+        assert ckpt.verify_checkpoint(str(tmp_path / "global_step2")) == []
+        # the older commit must not rewind `latest` past the sync save
+        assert (tmp_path / "latest").read_text() == "global_step3"
+    finally:
+        mgr.close()
+
+
+def test_fallback_everything_corrupt_raises(tmp_path):
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    d = e.save_checkpoint(str(tmp_path))
+    _flip_byte(_largest_file(d))
+    with pytest.raises(ckpt.CheckpointVerifyError):
+        e.load_checkpoint(str(tmp_path), fallback=True)
+
+
+def test_resolve_newest_verified(tmp_path):
+    e = make_engine()
+    dirs = {}
+    for i in range(2):
+        e.train_batch(batch(e, i))
+        dirs[e.global_steps] = e.save_checkpoint(str(tmp_path))
+    assert ckpt.resolve_newest_verified(str(tmp_path)) == "global_step2"
+    _flip_byte(_largest_file(dirs[2]))
+    assert ckpt.resolve_newest_verified(str(tmp_path)) == "global_step1"
+    _flip_byte(_largest_file(dirs[1]))
+    assert ckpt.resolve_newest_verified(str(tmp_path)) is None
+    assert ckpt.resolve_newest_verified(str(tmp_path / "nowhere")) is None
+
+
+def test_maybe_auto_resume_env(tmp_path, monkeypatch):
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    e.save_checkpoint(str(tmp_path))
+    mesh_mod.set_mesh(None)
+    e2 = make_engine()
+    monkeypatch.delenv(ckpt.RESUME_DIR_ENV, raising=False)
+    assert ckpt.maybe_auto_resume(e2) is None            # env unset: no-op
+    monkeypatch.setenv(ckpt.RESUME_DIR_ENV, str(tmp_path))
+    out = ckpt.maybe_auto_resume(e2)
+    assert out is not None and out[0].endswith("global_step1")
+    assert e2.global_steps == 1
+    # empty save dir: fresh start, not an error
+    monkeypatch.setenv(ckpt.RESUME_DIR_ENV, str(tmp_path / "fresh"))
+    assert ckpt.maybe_auto_resume(e2) is None
+
+
+# ---------------- SIGTERM chaining ----------------
+
+def test_sigterm_chains_to_previous_handler(tmp_path):
+    from deepspeed_tpu.telemetry import flightrec
+
+    if flightrec.sigterm_managed():
+        pytest.skip("flight recorder owns SIGTERM in this process")
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        e = make_engine()
+        mgr = ckpt.AsyncCheckpointManager(e, str(tmp_path),
+                                          install_sigterm=True)
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert mgr.preempted
+            assert seen == [signal.SIGTERM]      # chained, not dropped
+        finally:
+            mgr.close()
+        # close() restored our handler
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert len(seen) == 2
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_sigterm_flightrec_hook_mode(tmp_path, monkeypatch):
+    """When the flight recorder owns SIGTERM (its handler re-delivers
+    the signal after hooks + dump), the manager must register a hook
+    that performs the final SYNCHRONOUS save — not stomp the handler."""
+    from deepspeed_tpu.telemetry import flightrec
+
+    monkeypatch.setattr(flightrec, "sigterm_managed", lambda: True)
+    before = signal.getsignal(signal.SIGTERM)
+    n_hooks = len(flightrec._sigterm_hooks)
+    e = make_engine()
+    e.train_batch(batch(e, 0))
+    mgr = ckpt.AsyncCheckpointManager(e, str(tmp_path),
+                                      install_sigterm=True)
+    try:
+        assert signal.getsignal(signal.SIGTERM) is before   # untouched
+        assert len(flightrec._sigterm_hooks) == n_hooks + 1
+        flightrec._sigterm_hooks[-1]()       # what SIGTERM would run
+        assert mgr.preempted
+        assert (tmp_path / "latest").read_text() == "global_step1"
+        assert ckpt.verify_checkpoint(
+            str(tmp_path / "global_step1")) == []
+    finally:
+        mgr.close()
+    assert len(flightrec._sigterm_hooks) == n_hooks
+
+
+def test_async_manager_retention(tmp_path):
+    e = make_engine()
+    mgr = ckpt.AsyncCheckpointManager(e, str(tmp_path),
+                                      install_sigterm=False,
+                                      keep_last_n=1)
+    try:
+        for i in range(3):
+            e.train_batch(batch(e, i))
+            mgr.save(sync=True)
+    finally:
+        mgr.close()
+    kept = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert kept == ["global_step3"]
+    assert (tmp_path / "latest").read_text() == "global_step3"
+
+
+# ---------------- dataloader resume state ----------------
+
+def _collect(it, n):
+    return [next(it) for _ in range(n)]
+
+
+def _key(batches):
+    return [np.asarray(b["x"]).tobytes() for b in batches]
+
+
+def test_dataloader_state_roundtrip_across_epochs():
+    ds = random_dataset(12, 4, seed=1)
+    mk = lambda: RepeatingLoader(DeepSpeedDataLoader(  # noqa: E731
+        ds, batch_size=4, shuffle=True, seed=7))
+    a = mk()
+    _collect(iter(a), 4)                  # 3 batches/epoch: into epoch 2
+    state = a.state_dict()
+    assert state["epoch"] == 1 and state["batch_index"] == 1
+    rest_a = _collect(iter(a), 5)
+    b = mk()
+    b.load_state_dict(state)
+    rest_b = _collect(iter(b), 5)
+    assert _key(rest_a) == _key(rest_b)
+
+
+def test_dataloader_state_mismatch_raises():
+    ds = random_dataset(8, 4, seed=1)
+    loader = DeepSpeedDataLoader(ds, batch_size=4, shuffle=True, seed=7)
+    with pytest.raises(ValueError):
+        loader.load_state_dict({"epoch": 0, "batch_index": 1, "seed": 8,
+                                "shuffle": True, "batch_size": 4})
+    with pytest.raises(ValueError):
+        loader.load_state_dict({"epoch": 0, "batch_index": 1, "seed": 7,
+                                "shuffle": False, "batch_size": 4})
+
+
+# ---------------- guard detectors + TrainGuard ----------------
+
+class _SeriesStub:
+    def __init__(self):
+        self.series = {n: anomaly.Series() for n in
+                       ("train_loss", "train_grad_norm")}
+
+
+def test_loss_spike_detector_fires_and_clears():
+    d = anomaly.LossSpikeDetector(ratio=3.0, history=4)
+    eng = _SeriesStub()
+    s = eng.series["train_loss"]
+    events = []
+    for i in range(6):
+        s.add(float(i), 1.0)
+        events += d.step(eng, float(i))
+    assert events == [] and not d.firing
+    for i in range(6, 8):                    # sustained 10x spike
+        s.add(float(i), 10.0)
+        events += d.step(eng, float(i))
+    assert d.firing
+    assert [e["state"] for e in events] == ["firing"]
+    for i in range(8, 12):                   # back to normal → clears
+        s.add(float(i), 1.0)
+        events += d.step(eng, float(i))
+    assert not d.firing
+    assert [e["state"] for e in events] == ["firing", "cleared"]
+
+
+def test_loss_spike_detector_negative_and_tiny_baselines():
+    """Deviation-from-baseline form: a steady negative objective (ELBO)
+    must never fire, and near-zero jitter stays under the min_scale
+    floor — but a genuine jump from either baseline fires."""
+    for base, jitter, spike in ((-5.0, -4.9, 20.0), (1e-7, 1e-5, 0.5)):
+        d = anomaly.LossSpikeDetector(ratio=3.0, history=4)
+        eng = _SeriesStub()
+        s = eng.series["train_loss"]
+        for i in range(8):
+            s.add(float(i), base if i % 2 else jitter)
+            assert d.step(eng, float(i)) == [], (base, jitter)
+        fired = []
+        for i in range(8, 10):
+            s.add(float(i), spike)
+            fired += d.step(eng, float(i))
+        assert d.firing, (base, spike)
+
+
+def test_grad_norm_detector_nonfinite():
+    d = anomaly.GradNormExplosionDetector(ratio=10.0, history=4)
+    eng = _SeriesStub()
+    s = eng.series["train_grad_norm"]
+    for i in range(4):
+        s.add(float(i), 0.5)
+        assert d.step(eng, float(i)) == []
+    fired = []
+    for i in range(4, 6):
+        s.add(float(i), float("nan"))
+        fired += d.step(eng, float(i))
+    assert d.firing and fired[0]["detail"]["nonfinite"]
+    d.reset()
+    assert not d.firing
+
+
+def test_train_guard_snapshot_mode(tmp_path):
+    e = make_engine()
+    eng = anomaly.AnomalyEngine(detectors=[
+        anomaly.LossSpikeDetector(ratio=3.0, history=4),
+        anomaly.GradNormExplosionDetector(ratio=10.0, history=4)])
+    guard = TrainGuard(e, str(tmp_path), rollback=False,
+                       anomaly_engine=eng)
+    try:
+        assert e._train_guard is guard
+        for i in range(3):
+            e.train_batch(batch(e, i))      # engine hook feeds the series
+        assert len(eng.series["train_loss"]) >= 3
+        # sustained synthetic spike → snapshot checkpoint
+        for _ in range(4):
+            guard.on_step({"loss": np.float32(1e6),
+                           "grad_norm": np.float32(0.1)})
+        assert guard.snapshots == 1
+        tag = f"guard_step{e.global_steps}"
+        assert (tmp_path / tag).is_dir()
+        assert ckpt.verify_checkpoint(str(tmp_path / tag)) == []
+        # a forensic snapshot of DIVERGING state must never become what
+        # a restart resumes from: no `latest` repoint, and neither the
+        # auto-resume resolve nor the fallback walk may pick it
+        assert not (tmp_path / "latest").exists()
+        assert ckpt.resolve_newest_verified(str(tmp_path)) is None
+        with pytest.raises(ckpt.CheckpointVerifyError):
+            ckpt.load_checkpoint(e, str(tmp_path), fallback=True)
+        # guard tags are invisible to retention GC
+        assert ckpt.gc_checkpoints(str(tmp_path), keep_last_n=1) == []
+    finally:
+        guard.close()
+    assert e._train_guard is None
